@@ -1,0 +1,32 @@
+"""Test bootstrap: fake an 8-chip mesh on host CPU.
+
+The reference could only be tested on a real CUDA+MPI cluster (SURVEY.md §4 —
+manual mpirun scripts, no CI).  We instead force 8 virtual CPU devices so
+every collective path (psum, ppermute rings, shardings) runs in unit tests
+with no TPU attached.  force_host_devices handles the platform/flag overrides.
+"""
+
+
+from theanompi_tpu.parallel.mesh import force_host_devices  # noqa: E402
+
+force_host_devices(8)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from theanompi_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(n_data=8)
+
+
+@pytest.fixture(scope="session")
+def mesh4x2():
+    from theanompi_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(n_data=4, n_model=2)

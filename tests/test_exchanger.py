@@ -1,0 +1,131 @@
+"""Exchanger strategy tests: every strategy must compute the cross-replica mean.
+
+Reference parity target (SURVEY.md §2.1): BSP_Exchanger.exchange() averaging
+worker gradients; strategies ar/asa32/asa16/nccl32/nccl16 → psum/ring/…bf16.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from theanompi_tpu.parallel.mesh import shard_map
+from jax.sharding import PartitionSpec as P
+
+from theanompi_tpu.parallel.exchanger import STRATEGIES, Exchanger
+from theanompi_tpu.parallel.mesh import DATA_AXIS
+
+
+def _run_exchange(mesh, strategy, per_device_vals):
+    """per_device_vals: [n, ...] array; returns exchanged per-device output."""
+    n = mesh.shape[DATA_AXIS]
+    ex = Exchanger(strategy=strategy)
+
+    def f(x):
+        return jax.tree.map(lambda a: a[0], ex.exchange({"g": x}))["g"][None]
+
+    out = shard_map(
+        f, mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS),
+        check=False,
+    )(per_device_vals)
+    return np.asarray(out)
+
+
+@pytest.mark.parametrize("strategy", sorted(STRATEGIES))
+def test_strategy_computes_mean(mesh8, strategy):
+    rng = np.random.RandomState(0)
+    vals = rng.randn(8, 3, 5).astype(np.float32)
+    out = _run_exchange(mesh8, strategy, jnp.asarray(vals))
+    expect = vals.mean(axis=0)
+    tol = 1e-2 if "bf16" in strategy else 1e-6
+    for i in range(8):
+        np.testing.assert_allclose(out[i], expect, rtol=tol, atol=tol)
+
+
+@pytest.mark.parametrize("strategy", ["ring", "psum"])
+def test_strategy_ragged_sizes(mesh8, strategy):
+    # sizes not divisible by n exercise the ring's padding path
+    rng = np.random.RandomState(1)
+    vals = rng.randn(8, 13).astype(np.float32)  # 13 not divisible by 8
+    out = _run_exchange(mesh8, strategy, jnp.asarray(vals))
+    for i in range(8):
+        np.testing.assert_allclose(out[i], vals.mean(axis=0), rtol=1e-5, atol=1e-5)
+
+
+def test_exchange_identity_on_single_device_mesh():
+    from theanompi_tpu.parallel.mesh import make_mesh
+
+    mesh1 = make_mesh(n_data=1, devices=jax.devices()[:1])
+    ex = Exchanger()
+
+    def f(t):
+        out = ex.exchange(jax.tree.map(lambda a: a[0], t))
+        return jax.tree.map(lambda a: a[None], out)
+
+    tree = {"a": jnp.ones((1, 2)), "b": [jnp.zeros((1, 3))]}
+    out = shard_map(f, mesh1, P(DATA_AXIS), P(DATA_AXIS), check=False)(tree)
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.ones((1, 2)))
+
+
+def test_exchange_outside_mapped_context_raises():
+    ex = Exchanger()
+    with pytest.raises(ValueError, match="inside shard_map"):
+        ex.exchange({"a": jnp.ones((2,))})
+
+
+def test_int_leaves_pass_through_unreduced(mesh8):
+    # opt-state pytrees may carry int step counters; exchange must not
+    # mean-reduce them into floats
+    ex = Exchanger(strategy="psum")
+
+    def f(t):
+        local = jax.tree.map(lambda a: a[0], t)
+        return jax.tree.map(lambda a: a[None], ex.exchange(local))
+
+    out = shard_map(
+        f, mesh=mesh8, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS), check=False
+    )({"w": jnp.ones((8, 2)), "step": jnp.full((8, 1), 7, jnp.int32)})
+    assert out["step"].dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(out["step"]).ravel(), 7)
+
+
+def test_unknown_strategy_raises():
+    with pytest.raises(ValueError):
+        Exchanger(strategy="warp_drive")
+
+
+def test_bf16_strategy_halves_error_not_correctness(mesh8):
+    # all-equal inputs: bf16 path must be exact
+    vals = jnp.full((8, 4), 3.0, jnp.float32)
+    out = _run_exchange(mesh8, "psum_bf16", vals)
+    np.testing.assert_allclose(out, 3.0)
+
+
+def test_exchanger_inside_jit_grad_pipeline(mesh8):
+    """End-to-end shape: per-device grads -> exchange -> identical updates."""
+    n = 8
+    ex = Exchanger(strategy="psum")
+
+    def per_device_loss(w, x):
+        return jnp.sum((x @ w) ** 2)
+
+    def step(w, x):
+        g = jax.grad(per_device_loss)(w[0], x)
+        g = ex.exchange(g)
+        return (w[0] - 0.1 * g)[None]
+
+    rng = np.random.RandomState(2)
+    w = jnp.asarray(np.tile(rng.randn(1, 4, 2).astype(np.float32), (8, 1, 1)))
+    x = jnp.asarray(rng.randn(8 * 3, 4).astype(np.float32))
+
+    f = jax.jit(
+        shard_map(
+            step, mesh=mesh8,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=P(DATA_AXIS),
+            check=False,
+        )
+    )
+    w_new = np.asarray(f(w, x.reshape(8, 3, 4).reshape(24, 4)))
+    # every replica must hold the same updated params
+    for i in range(1, 8):
+        np.testing.assert_allclose(w_new[i], w_new[0], rtol=1e-6, atol=1e-6)

@@ -1,0 +1,49 @@
+"""theanompi_tpu — a TPU-native distributed training framework.
+
+A from-scratch rebuild of the *capabilities* of Theano-MPI
+(Sentient07/Theano-MPI; see /root/repo/SURVEY.md — the reference mount was
+empty during the survey, so reference paths cited throughout this package are
+the *expected upstream* paths from SURVEY.md §1–2, tagged "unverified"):
+
+- pluggable parameter-exchange **rules**: ``BSP`` (synchronous all-reduce),
+  ``EASGD`` (elastic-averaging parameter server), ``GOSGD`` (gossip)
+  — reference (unverified): ``theanompi/__init__.py``;
+- a strategy-pluggable **exchanger** — reference: ``theanompi/lib/exchanger.py``
+  + ``exchanger_strategy.py`` (``ar``/``asa32``/``asa16``/``nccl32``/``nccl16``)
+  — here re-expressed as XLA collectives (``psum``/``ppermute``) over ICI with
+  bf16-compressed variants;
+- a **model zoo** (AlexNet, GoogLeNet, VGG16, ResNet-50, Wide-ResNet, LSTM,
+  DCGAN/WGAN) conforming to a duck-typed model contract;
+- a parallel **data layer** with compute/IO overlap (``para_load`` equivalent);
+- a **launcher** (``tmlauncher`` equivalent), **recorder**, and checkpointing.
+
+Nothing here is a port: there is no mpirun, no per-GPU process, no NCCL.  One
+controller traces the training step once; XLA compiles it SPMD over a
+``jax.sharding.Mesh`` and inserts ICI collectives where the shardings demand.
+"""
+
+__version__ = "0.1.0"
+
+_RULES = {
+    "BSP": "theanompi_tpu.parallel.bsp",
+    "EASGD": "theanompi_tpu.parallel.easgd",
+    "GOSGD": "theanompi_tpu.parallel.gosgd",
+}
+
+__all__ = ["BSP", "EASGD", "GOSGD", "__version__"]
+
+
+def __getattr__(name):
+    # Lazy rule imports keep `import theanompi_tpu` cheap (no jax trace-time
+    # imports until a rule is actually used), mirroring the reference's
+    # top-level `from theanompi import BSP` API (SURVEY.md §2.1, unverified).
+    if name in _RULES:
+        import importlib
+
+        try:
+            return getattr(importlib.import_module(_RULES[name]), name)
+        except ImportError as e:
+            raise AttributeError(
+                f"rule {name!r} failed to import from {_RULES[name]}: {e}"
+            ) from e
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

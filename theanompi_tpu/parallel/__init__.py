@@ -1,0 +1,26 @@
+"""Parallelism layer: mesh runtime, exchanger strategies, and training rules.
+
+TPU-native replacement for the reference's process/communication layer
+(reference, unverified — SURVEY.md §1: ``theanompi/lib/base.py`` [MPI_GPU_process],
+``theanompi/lib/exchanger.py``, ``theanompi/lib/exchanger_strategy.py``, plus the
+per-rule worker scripts ``bsp_worker.py`` / ``easgd_*.py`` / ``gosgd_worker.py``).
+"""
+
+from theanompi_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    Precision,
+    make_mesh,
+    replica_rng,
+)
+from theanompi_tpu.parallel.exchanger import Exchanger, STRATEGIES
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "Precision",
+    "make_mesh",
+    "replica_rng",
+    "Exchanger",
+    "STRATEGIES",
+]

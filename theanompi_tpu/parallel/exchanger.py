@@ -1,0 +1,174 @@
+"""Strategy-pluggable parameter/gradient exchanger — the heart of the rebuild.
+
+Reference (unverified — SURVEY.md §2.1): ``theanompi/lib/exchanger.py``
+(``BSP_Exchanger.exchange()`` summing worker grads/params each iteration) and
+``theanompi/lib/exchanger_strategy.py`` with config-string-selected collective
+implementations:
+
+====================  =============================================  =====================
+reference strategy    what it did (GPU/MPI era)                      TPU-native analogue
+====================  =============================================  =====================
+``ar``                CUDA-aware ``MPI.Allreduce`` on gpuarray bufs  ``psum``
+``nccl32``            pygpu/NCCL ``all_reduce`` fp32                 ``psum``
+``asa16``/``nccl16``  fp16-compressed exchange                       ``psum_bf16``
+``asa32``             alltoall-sum-allgather ring                    ``ring``
+``copper``/``16``     host-staged copy path                          ``ring_bf16``
+====================  =============================================  =====================
+
+Every strategy here is a *pure function applied inside ``shard_map``* over the
+``data`` mesh axis; XLA lowers ``psum``/``ppermute`` to ICI collectives, so
+the "CUDA-aware" zero-copy property of the reference is automatic.  The
+``ring*`` strategies are the explicit reduce-scatter/all-gather formulation
+(the shape of the reference's ``asa`` strategies) built from ``ppermute`` —
+mostly valuable as the template for custom collective schedules (and reused by
+ring attention), since XLA's own ``psum`` lowering is already ring-based.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from theanompi_tpu.parallel.mesh import DATA_AXIS
+
+# strategy name -> fn(x, axis_name, axis_size) -> mean-reduced x
+STRATEGIES: dict[str, Callable] = {}
+
+
+def register_strategy(name: str):
+    def deco(fn):
+        STRATEGIES[name] = fn
+        return fn
+
+    return deco
+
+
+@register_strategy("psum")
+def _psum_mean(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
+    """Plain all-reduce mean (reference ``ar``/``nccl32``)."""
+    return lax.psum(x, axis_name) / axis_size
+
+
+@register_strategy("psum_bf16")
+def _psum_bf16_mean(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
+    """bf16-compressed all-reduce (reference ``asa16``/``nccl16``).
+
+    Halves ICI bytes; the mean is taken in fp32 after decompression to avoid
+    bf16 accumulation error growing with worker count.
+    """
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        return _psum_mean(x, axis_name, axis_size)
+    summed = lax.psum(x.astype(jnp.bfloat16), axis_name)
+    return (summed.astype(jnp.float32) / axis_size).astype(x.dtype)
+
+
+def _ring_allreduce(x: jax.Array, axis_name: str, n: int, wire_dtype=None) -> jax.Array:
+    """Explicit ring all-reduce: reduce-scatter then all-gather via ppermute.
+
+    Equivalent communication shape to the reference's ``asa32``/``asa16``
+    (alltoall-sum-allgather) strategies.  2*(n-1) ppermute steps, each moving
+    1/n of the buffer around the ring.
+    """
+    if n == 1:
+        return x
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    pad = (-flat.size) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    chunks = flat.reshape(n, -1)
+    if wire_dtype is not None and jnp.issubdtype(orig_dtype, jnp.floating):
+        chunks = chunks.astype(wire_dtype)
+    idx = lax.axis_index(axis_name)
+    ring = [(i, (i + 1) % n) for i in range(n)]
+
+    # Reduce-scatter: after step s, device i holds the partial sum of chunk
+    # (i - s - 1) mod n over s+2 contributors; after n-1 steps, device i owns
+    # the complete chunk (i + 1) mod n.
+    for s in range(n - 1):
+        send = jnp.take(chunks, (idx - s) % n, axis=0)
+        recv = lax.ppermute(send, axis_name, ring)
+        tgt = (idx - s - 1) % n
+        chunks = lax.dynamic_update_index_in_dim(
+            chunks, lax.dynamic_index_in_dim(chunks, tgt, 0, keepdims=False) + recv,
+            tgt, 0,
+        )
+    # All-gather: circulate the completed chunks.
+    for s in range(n - 1):
+        send = jnp.take(chunks, (idx + 1 - s) % n, axis=0)
+        recv = lax.ppermute(send, axis_name, ring)
+        chunks = lax.dynamic_update_index_in_dim(chunks, recv, (idx - s) % n, 0)
+
+    out = chunks.astype(jnp.float32) if wire_dtype is not None else chunks
+    out = out.reshape(-1)[: flat.size - pad if pad else flat.size]
+    return out.reshape(orig_shape).astype(orig_dtype)
+
+
+@register_strategy("ring")
+def _ring_mean(x, axis_name, axis_size):
+    return _ring_allreduce(x, axis_name, axis_size) / axis_size
+
+
+@register_strategy("ring_bf16")
+def _ring_bf16_mean(x, axis_name, axis_size):
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        return _ring_mean(x, axis_name, axis_size)
+    out = _ring_allreduce(x, axis_name, axis_size, wire_dtype=jnp.bfloat16)
+    return (out.astype(jnp.float32) / axis_size).astype(x.dtype)
+
+
+class Exchanger:
+    """Averages a gradient/parameter pytree across the ``data`` axis.
+
+    Reference: ``BSP_Exchanger`` (SURVEY.md §2.1) — there, a post-step host
+    call dispatching to MPI/NCCL; here, a pure pytree transform invoked
+    *inside* the compiled train step, so XLA overlaps the collective with
+    remaining compute where the dependence structure allows.
+
+    ``strategy`` is the plug point, preserved from the reference's
+    config-string mechanism: one of ``STRATEGIES`` keys.  The axis size is
+    derived *inside* the mapped context (``lax.axis_size``), so it can never
+    disagree with the actual mesh.
+    """
+
+    def __init__(self, strategy: str = "psum", axis_name: str = DATA_AXIS):
+        if strategy not in STRATEGIES:
+            raise ValueError(
+                f"unknown exchange strategy {strategy!r}; "
+                f"available: {sorted(STRATEGIES)}"
+            )
+        self.strategy = strategy
+        self.axis_name = axis_name
+        self._fn = STRATEGIES[strategy]
+
+    def exchange(self, tree):
+        """Mean-reduce every floating leaf across the data axis.
+
+        Call inside ``shard_map`` over a mesh that binds ``axis_name``.
+        Non-float leaves (step counters and other bookkeeping that may ride
+        along in an optimizer-state pytree) pass through unchanged —
+        mean-reducing them would silently promote ints to floats.
+        """
+        try:
+            n = lax.axis_size(self.axis_name)
+        except NameError as e:
+            raise ValueError(
+                f"Exchanger.exchange must run inside shard_map over a mesh "
+                f"binding axis {self.axis_name!r}"
+            ) from e
+        if n == 1:
+            return tree
+
+        def reduce_leaf(x):
+            if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+                return x
+            return self._fn(x, axis_name=self.axis_name, axis_size=n)
+
+        return jax.tree.map(reduce_leaf, tree)
+
+    def __repr__(self):
+        return f"Exchanger(strategy={self.strategy!r}, axis={self.axis_name!r})"

@@ -1,0 +1,164 @@
+"""Device mesh runtime: discovery, mesh construction, precision policy, RNG.
+
+This is the TPU-native analogue of the reference's process bootstrap
+(reference, unverified — SURVEY.md §2.1: ``theanompi/lib/base.py`` class
+``MPI_GPU_process``: binds one GPU per OS process via ``theano.gpuarray``,
+builds an ``MPI.COMM_WORLD`` plus an intra-node NCCL clique).  On TPU there is
+no per-device process and no explicit communicator object: a single controller
+builds a :class:`jax.sharding.Mesh` over the chips, and XLA lowers collective
+ops over its named axes to ICI/DCN traffic.  "Binding a device" becomes
+"naming a mesh axis"; the NCCL clique becomes the mesh itself.
+
+Axes convention:
+
+- ``data``  — data parallelism (the reference's only parallelism; one worker
+  per reference GPU maps to one slice along this axis),
+- ``model`` — tensor parallelism (beyond reference capability, here from day
+  one so shardings compose),
+- ``seq``   — sequence/context parallelism for ring attention
+  (see :mod:`theanompi_tpu.parallel.ring_attention`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def force_host_devices(n: int) -> None:
+    """Force ``n`` virtual CPU devices.  Must run before the first backend init.
+
+    The test-suite analogue of the reference's multi-GPU cluster: the reference
+    could only be tested on a real CUDA+MPI cluster (SURVEY.md §4); we fake an
+    ``n``-chip mesh on host CPU so every collective path is unit-testable.
+
+    Handles both late-env pitfalls: an existing device-count flag is replaced
+    (not silently kept), and because this image's sitecustomize imports jax at
+    interpreter start with ``JAX_PLATFORMS`` baked into config defaults, the
+    platform is forced via ``jax.config`` rather than the (too-late) env var.
+    """
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+    os.environ["XLA_FLAGS"] = (
+        flags.strip() + f" --xla_force_host_platform_device_count={n}"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+
+
+def make_mesh(
+    n_data: int | None = None,
+    n_model: int = 1,
+    n_seq: int = 1,
+    devices: Sequence[Any] | None = None,
+) -> Mesh:
+    """Build a ``(data, model, seq)`` mesh over the available devices.
+
+    ``n_data=None`` consumes all devices left over after ``n_model``/``n_seq``.
+    A mesh of total size 1 is valid and is the single-worker ("CPU Theano
+    mode", BASELINE.md config 1) case.
+    """
+    if devices is None:
+        devices = jax.devices()
+    total = len(devices)
+    if n_data is None:
+        if total % (n_model * n_seq) != 0:
+            raise ValueError(
+                f"{total} devices not divisible by model*seq={n_model * n_seq}"
+            )
+        n_data = total // (n_model * n_seq)
+    need = n_data * n_model * n_seq
+    if need > total:
+        raise ValueError(f"need {need} devices, have {total}")
+    arr = np.asarray(devices[:need], dtype=object).reshape(n_data, n_model, n_seq)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_sharded(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Sharding that splits the leading (batch) dim over the ``data`` axis."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+@dataclasses.dataclass(frozen=True)
+class Precision:
+    """Mixed-precision policy: fp32 params, bf16 compute, fp32 outputs.
+
+    The reference's analogue is its fp16 exchange strategies (``asa16``,
+    ``nccl16`` — SURVEY.md §2.1, exchanger strategies) plus Theano's
+    ``floatX``.  On TPU the MXU natively consumes bf16, so compute-in-bf16 is
+    the default rather than a compression trick; the exchange-compression
+    analogue lives in :mod:`theanompi_tpu.parallel.exchanger` (``psum_bf16``).
+    """
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    output_dtype: Any = jnp.float32
+
+    def cast_to_compute(self, tree):
+        return jax.tree.map(self._cast(self.compute_dtype), tree)
+
+    def cast_to_param(self, tree):
+        return jax.tree.map(self._cast(self.param_dtype), tree)
+
+    def cast_to_output(self, tree):
+        return jax.tree.map(self._cast(self.output_dtype), tree)
+
+    @staticmethod
+    def _cast(dtype):
+        def cast(x):
+            # result_type (not isinstance) so numpy arrays and Python floats
+            # in a host-initialized params pytree are cast too, instead of
+            # silently passing through the policy.
+            if jnp.issubdtype(jnp.result_type(x), jnp.floating):
+                return jnp.asarray(x, dtype)
+            return x
+
+        return cast
+
+
+#: Full precision everywhere — for CPU tests and numerical-parity checks.
+FP32 = Precision(compute_dtype=jnp.float32)
+#: TPU default.
+BF16 = Precision()
+
+
+def shard_map(f, mesh: Mesh, in_specs, out_specs, check: bool = False):
+    """Thin wrapper over :func:`jax.shard_map` pinning this repo's defaults.
+
+    ``check=False`` disables varying-manual-axes checking: the ring strategies
+    (:mod:`theanompi_tpu.parallel.exchanger`) produce replicated outputs via
+    ``ppermute`` chains the checker cannot prove replicated.
+    """
+    return jax.shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check
+    )
+
+
+def replica_rng(key: jax.Array, axis_name: str = DATA_AXIS) -> jax.Array:
+    """Derive a distinct PRNG key per replica along ``axis_name``.
+
+    Call only inside ``shard_map``/collective context.  Replaces the
+    reference's per-process numpy seeding (each MPI rank seeded separately;
+    SURVEY.md §2.1 base.py) with a deterministic fold of the replica index.
+    """
+    return jax.random.fold_in(key, jax.lax.axis_index(axis_name))
