@@ -1,0 +1,200 @@
+"""Layer library unit tests (shapes, numerics, state handling)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theanompi_tpu.ops import (
+    Activation, AvgPool, BatchNorm, Conv2D, ConvTranspose2D, Dense, Dropout,
+    Embedding, Flatten, GlobalAvgPool, LayerNorm, LRN, LSTM, MaxPool,
+    Sequential,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_dense_shapes_and_linearity():
+    layer = Dense(7)
+    params, state, out_shape = layer.init(KEY, (4,))
+    assert out_shape == (7,)
+    x = jnp.ones((3, 4))
+    y, _ = layer.apply(params, state, x)
+    assert y.shape == (3, 7)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(x @ params["w"] + params["b"]), rtol=1e-6
+    )
+
+
+@pytest.mark.parametrize(
+    "padding,expect_hw", [("SAME", (8, 8)), ("VALID", (6, 6)), (1, (8, 8))]
+)
+def test_conv_padding_modes(padding, expect_hw):
+    layer = Conv2D(5, kernel=3, padding=padding)
+    params, state, out_shape = layer.init(KEY, (8, 8, 2))
+    assert out_shape == (*expect_hw, 5)
+    y, _ = layer.apply(params, state, jnp.ones((2, 8, 8, 2)))
+    assert y.shape == (2, *expect_hw, 5)
+
+
+def test_conv_stride_and_groups():
+    layer = Conv2D(8, kernel=3, stride=2, padding="SAME", groups=2)
+    params, _, out_shape = layer.init(KEY, (8, 8, 4))
+    assert out_shape == (4, 4, 8)
+    assert params["w"].shape == (3, 3, 2, 8)  # C/groups input channels
+
+
+def test_conv_identity_kernel():
+    # 1x1 identity kernel: conv must reproduce input exactly
+    layer = Conv2D(3, kernel=1, use_bias=False)
+    params, state, _ = layer.init(KEY, (5, 5, 3))
+    params = {"w": jnp.eye(3).reshape(1, 1, 3, 3)}
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 5, 3))
+    y, _ = layer.apply(params, state, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-6)
+
+
+def test_conv_transpose_upsamples():
+    layer = ConvTranspose2D(4, kernel=4, stride=2)
+    _, _, out_shape = layer.init(KEY, (8, 8, 3))
+    assert out_shape == (16, 16, 4)
+
+
+def test_pools():
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    y, _ = MaxPool(2).apply({}, {}, x)
+    np.testing.assert_array_equal(
+        np.asarray(y).squeeze(), [[5, 7], [13, 15]]
+    )
+    y, _ = AvgPool(2).apply({}, {}, x)
+    np.testing.assert_allclose(
+        np.asarray(y).squeeze(), [[2.5, 4.5], [10.5, 12.5]]
+    )
+    _, _, s = MaxPool(3, stride=2, padding="SAME").init(KEY, (7, 7, 2))
+    assert s == (4, 4, 2)
+    y, _ = GlobalAvgPool().apply({}, {}, x)
+    np.testing.assert_allclose(np.asarray(y), [[7.5]])
+
+
+def test_flatten():
+    _, _, s = Flatten().init(KEY, (3, 4, 5))
+    assert s == (60,)
+    y, _ = Flatten().apply({}, {}, jnp.ones((2, 3, 4, 5)))
+    assert y.shape == (2, 60)
+
+
+def test_dropout_train_eval():
+    layer = Dropout(0.5)
+    x = jnp.ones((4, 100))
+    y_eval, _ = layer.apply({}, {}, x, train=False)
+    np.testing.assert_array_equal(np.asarray(y_eval), np.asarray(x))
+    y_tr, _ = layer.apply({}, {}, x, train=True, rng=KEY)
+    arr = np.asarray(y_tr)
+    assert set(np.unique(arr)).issubset({0.0, 2.0})  # scaled by 1/keep
+    assert 0.3 < (arr == 0).mean() < 0.7
+    with pytest.raises(ValueError):
+        layer.apply({}, {}, x, train=True, rng=None)
+
+
+def test_batchnorm_normalizes_and_tracks():
+    layer = BatchNorm(momentum=0.5)
+    params, state, _ = layer.init(KEY, (3,))
+    x = jax.random.normal(jax.random.PRNGKey(2), (256, 3)) * 4.0 + 2.0
+    y, new_state = layer.apply(params, state, x, train=True)
+    arr = np.asarray(y)
+    np.testing.assert_allclose(arr.mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(arr.std(0), 1.0, atol=1e-2)
+    # running stats moved halfway toward batch stats (momentum 0.5)
+    np.testing.assert_allclose(np.asarray(new_state["mean"]), 1.0, atol=0.2)
+    # eval mode uses running stats, state unchanged
+    y2, s2 = layer.apply(params, new_state, x, train=False)
+    assert s2 is new_state
+
+
+def test_sync_batchnorm_matches_global_stats(mesh8):
+    from jax.sharding import PartitionSpec as P
+
+    from theanompi_tpu.parallel.mesh import DATA_AXIS, shard_map
+
+    layer = BatchNorm(axis_name=DATA_AXIS)
+    params, state, _ = layer.init(KEY, (3,))
+    x = jax.random.normal(jax.random.PRNGKey(3), (64, 3)) * 3.0 + 1.0
+
+    def f(x_local):
+        y, st = layer.apply(params, state, x_local, train=True)
+        return y, st["mean"][None]
+
+    y, means = shard_map(
+        f, mesh8, in_specs=P(DATA_AXIS), out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+    )(x)
+    # every replica must have computed identical (global) running means
+    m = np.asarray(means)
+    for i in range(1, 8):
+        np.testing.assert_allclose(m[i], m[0], rtol=1e-5)
+    # and the global mean must match the full-batch statistics
+    ref_layer = BatchNorm()
+    _, ref_state, _ = ref_layer.init(KEY, (3,))
+    _, ref_new = ref_layer.apply(params, ref_state, x, train=True)
+    np.testing.assert_allclose(m[0], np.asarray(ref_new["mean"]), rtol=1e-4)
+
+
+def test_layernorm():
+    layer = LayerNorm()
+    params, state, _ = layer.init(KEY, (8,))
+    x = jax.random.normal(jax.random.PRNGKey(4), (4, 8)) * 5 + 3
+    y, _ = layer.apply(params, state, x)
+    arr = np.asarray(y)
+    np.testing.assert_allclose(arr.mean(-1), 0.0, atol=1e-4)
+    np.testing.assert_allclose(arr.std(-1), 1.0, atol=1e-2)
+
+
+def test_lrn_matches_manual():
+    layer = LRN(size=3, alpha=1e-4, beta=0.75, k=2.0)
+    x = jax.random.normal(jax.random.PRNGKey(5), (1, 2, 2, 4))
+    y, _ = layer.apply({}, {}, x)
+    xn = np.asarray(x)
+    sq = xn**2
+    padded = np.pad(sq, [(0, 0)] * 3 + [(1, 1)])
+    win = padded[..., 0:4] + padded[..., 1:5] + padded[..., 2:6]
+    expect = xn / (2.0 + (1e-4 / 3) * win) ** 0.75
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-5)
+
+
+def test_embedding_and_lstm():
+    emb = Embedding(vocab=11, dim=6)
+    params, state, out_shape = emb.init(KEY, (5,))
+    assert out_shape == (5, 6)
+    ids = jnp.array([[1, 2, 3, 4, 10]])
+    e, _ = emb.apply(params, state, ids)
+    assert e.shape == (1, 5, 6)
+
+    lstm = LSTM(hidden=8)
+    params, state, out_shape = lstm.init(KEY, (5, 6))
+    assert out_shape == (5, 8)
+    h, _ = lstm.apply(params, state, e)
+    assert h.shape == (1, 5, 8)
+    assert bool(jnp.all(jnp.isfinite(h)))
+    # grads flow through the scan
+    g = jax.grad(lambda p: jnp.sum(lstm.apply(p, state, e)[0] ** 2))(params)
+    assert float(jnp.abs(g["wh"]).sum()) > 0
+
+
+def test_sequential_smoke_cnn():
+    net = Sequential([
+        Conv2D(4, 3), BatchNorm(), Activation("relu"), MaxPool(2),
+        Conv2D(8, 3), Activation("relu"), GlobalAvgPool(),
+        Dropout(0.1), Dense(10),
+    ])
+    params, state, out_shape = net.init(KEY, (16, 16, 3))
+    assert out_shape == (10,)
+    x = jax.random.normal(jax.random.PRNGKey(6), (2, 16, 16, 3))
+    y, new_state = net.apply(params, state, x, train=True, rng=KEY)
+    assert y.shape == (2, 10)
+    # BN state updated
+    assert not np.allclose(
+        np.asarray(new_state["01_batchnorm"]["mean"]),
+        np.asarray(state["01_batchnorm"]["mean"]),
+    )
+    # bf16 compute path: cast input, params stay fp32
+    y16, _ = net.apply(params, state, x.astype(jnp.bfloat16), train=False)
+    assert y16.dtype == jnp.bfloat16

@@ -1,0 +1,92 @@
+"""Optimizer and loss unit tests against hand-computed values."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theanompi_tpu.ops import SGD, Adam, RMSProp
+from theanompi_tpu.ops.losses import (
+    sigmoid_binary_cross_entropy,
+    softmax_cross_entropy,
+    top_k_error,
+)
+
+
+def test_sgd_vanilla_matches_formula():
+    opt = SGD()
+    params = {"w": jnp.array([1.0, 2.0])}
+    grads = {"w": jnp.array([0.5, -1.0])}
+    st = opt.init(params)
+    new, st = opt.update(grads, st, params, lr=0.1)
+    np.testing.assert_allclose(np.asarray(new["w"]), [0.95, 2.1], rtol=1e-6)
+
+
+def test_sgd_momentum_two_steps():
+    opt = SGD(momentum=0.9)
+    p = {"w": jnp.zeros(1)}
+    g = {"w": jnp.ones(1)}
+    st = opt.init(p)
+    p, st = opt.update(g, st, p, lr=1.0)  # v=-1, p=-1
+    np.testing.assert_allclose(np.asarray(p["w"]), [-1.0])
+    p, st = opt.update(g, st, p, lr=1.0)  # v=-1.9, p=-2.9
+    np.testing.assert_allclose(np.asarray(p["w"]), [-2.9], rtol=1e-6)
+
+
+def test_sgd_nesterov_differs_from_classic():
+    g = {"w": jnp.ones(1)}
+    p0 = {"w": jnp.zeros(1)}
+    classic = SGD(momentum=0.9)
+    nest = SGD(momentum=0.9, nesterov=True)
+    pc, _ = classic.update(g, classic.init(p0), p0, lr=1.0)
+    pn, _ = nest.update(g, nest.init(p0), p0, lr=1.0)
+    np.testing.assert_allclose(np.asarray(pn["w"]), [-1.9], rtol=1e-6)
+    assert not np.allclose(np.asarray(pc["w"]), np.asarray(pn["w"]))
+
+
+def test_weight_decay_shrinks_params():
+    opt = SGD(weight_decay=0.1)
+    p = {"w": jnp.array([10.0])}
+    g = {"w": jnp.array([0.0])}
+    new, _ = opt.update(g, opt.init(p), p, lr=0.5)
+    np.testing.assert_allclose(np.asarray(new["w"]), [9.5])  # 10 - 0.5*0.1*10
+
+
+@pytest.mark.parametrize("opt", [SGD(momentum=0.9), Adam(), RMSProp()])
+def test_optimizers_descend_quadratic(opt):
+    def loss(p):
+        return jnp.sum((p["w"] - 3.0) ** 2)
+
+    p = {"w": jnp.zeros(4)}
+    st = opt.init(p)
+    lr = 0.1 if not isinstance(opt, Adam) else 0.3
+    for _ in range(60):
+        g = jax.grad(loss)(p)
+        p, st = opt.update(g, st, p, lr)
+    assert float(loss(p)) < 0.05
+
+
+def test_softmax_cross_entropy_matches_manual():
+    logits = jnp.array([[2.0, 1.0, 0.0], [0.0, 0.0, 0.0]])
+    labels = jnp.array([0, 2])
+    got = float(softmax_cross_entropy(logits, labels))
+    p0 = np.exp(2.0) / (np.exp(2.0) + np.exp(1.0) + 1.0)
+    expect = (-np.log(p0) - np.log(1 / 3)) / 2
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+    # bf16 logits still give fp32-precision loss
+    got16 = float(softmax_cross_entropy(logits.astype(jnp.bfloat16), labels))
+    np.testing.assert_allclose(got16, expect, rtol=1e-2)
+
+
+def test_bce_matches_manual():
+    logits = jnp.array([0.0, 100.0, -100.0])
+    targets = jnp.array([0.5, 1.0, 0.0])
+    got = float(sigmoid_binary_cross_entropy(logits, targets))
+    np.testing.assert_allclose(got, np.log(2.0) / 3, rtol=1e-5)
+
+
+def test_top_k_error():
+    logits = jnp.array([[3.0, 2.0, 1.0], [3.0, 2.0, 1.0]])
+    labels = jnp.array([0, 2])
+    assert float(top_k_error(logits, labels, k=1)) == 0.5
+    assert float(top_k_error(logits, labels, k=3)) == 0.0

@@ -30,7 +30,9 @@ _RULES = {
     "GOSGD": "theanompi_tpu.parallel.gosgd",
 }
 
-__all__ = ["BSP", "EASGD", "GOSGD", "__version__"]
+# NOTE: only importable rules may appear here (star-import contract); EASGD
+# and GOSGD join when their modules land.
+__all__ = ["BSP", "__version__"]
 
 
 def __getattr__(name):

@@ -1,0 +1,10 @@
+"""Model zoo conforming to the framework's model contract.
+
+Reference (unverified — SURVEY.md §2.1): ``theanompi/models/`` — AlexNet,
+GoogLeNet, VGG16, ResNet-50, Wide-ResNet, PTB LSTM, DCGAN/WGAN, each a class
+satisfying the duck-typed contract the rules drive (SURVEY.md §2.3).
+"""
+
+from theanompi_tpu.models.contract import Model, SupervisedModel
+
+__all__ = ["Model", "SupervisedModel"]

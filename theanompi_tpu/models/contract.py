@@ -1,0 +1,137 @@
+"""The model contract: the duck-typed interface rules drive models through.
+
+Reference (unverified — SURVEY.md §2.3): upstream documents "how to add a
+customized model" — a class with ``__init__(config)``, attributes
+``batch_size``/``n_epochs``/``data``/``params``, methods
+``compile_iter_fns``/``train_iter``/``val_iter``/``adjust_hyperp``/
+``scale_lr``/``cleanup``.  The split here is the idiomatic-jax factoring of
+exactly that contract:
+
+- the **model** owns hyperparameters, the data object, pure ``init_params``
+  and ``loss_fn``, the LR schedule (``adjust_hyperp``) and the optimizer
+  choice — everything that defines *what* is trained;
+- the **rule's trainer** owns compilation and iteration
+  (``compile_iter_fns``/``train_iter``/``val_iter`` live there) — everything
+  about *how* steps execute and exchange.
+
+``loss_fn`` is pure and traced once; there is no ``theano.function``
+compile-per-model machinery to port — ``jax.jit`` over the rule's step *is*
+the ``mode=XLA`` linker the north star asks for.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.ops import SGD, softmax_cross_entropy, top_k_error
+from theanompi_tpu.ops.layers import Layer
+from theanompi_tpu.parallel.mesh import BF16, FP32, Precision
+
+
+class Model:
+    """Base model: config merging + the contract surface.
+
+    Subclasses must provide ``build_data()`` and either override
+    ``init_params``/``loss_fn`` or use :class:`SupervisedModel`.
+    """
+
+    default_config: dict[str, Any] = {}
+
+    def __init__(self, config: dict[str, Any] | None = None):
+        self.config = {**self.default_config, **(config or {})}
+        self.verbose = self.config.get("verbose", True)
+        self.batch_size = self.config.get("batch_size", 128)
+        self.n_epochs = self.config.get("n_epochs", 10)
+        self.precision: Precision = (
+            BF16 if self.config.get("precision", "bf16") == "bf16" else FP32
+        )
+        self.data = self.build_data()
+
+    # -- construction hooks -------------------------------------------------
+    def build_data(self):
+        raise NotImplementedError
+
+    def build_optimizer(self):
+        return SGD(
+            momentum=self.config.get("momentum", 0.9),
+            weight_decay=self.config.get("weight_decay", 0.0),
+            nesterov=self.config.get("nesterov", False),
+        )
+
+    # -- pure functions the trainer compiles --------------------------------
+    def init_params(self, rng):
+        """-> (params, state) pytrees (fp32 params; state = BN buffers etc.)."""
+        raise NotImplementedError
+
+    def loss_fn(self, params, state, batch, rng, train: bool):
+        """-> (loss, (new_state, metrics)).  Pure; traced under jit."""
+        raise NotImplementedError
+
+    # -- schedule -----------------------------------------------------------
+    def adjust_hyperp(self, epoch: int) -> float:
+        """Learning rate for ``epoch`` (reference method name preserved).
+
+        Default: base LR with step decay at configured epochs.
+        """
+        lr = self.config.get("lr", 0.1)
+        for e in self.config.get("lr_decay_epochs", ()):
+            if epoch >= e:
+                lr *= self.config.get("lr_decay_factor", 0.1)
+        return lr
+
+    def scale_lr(self, size: int) -> None:
+        """Linear LR scaling with worker count (reference EASGD hook)."""
+        self.config["lr"] = self.config.get("lr", 0.1) * size
+
+    def cleanup(self) -> None:
+        if hasattr(self.data, "cleanup"):
+            self.data.cleanup()
+
+
+class SupervisedModel(Model):
+    """Classification models: a net (ops layers) + softmax CE + top-k error.
+
+    Subclasses implement ``build_net() -> (Layer, in_shape)``; batches are
+    ``{"x": [B, ...], "y": [B] int}``.
+    """
+
+    def __init__(self, config=None):
+        super().__init__(config)
+        self.net, self.in_shape = self.build_net()
+
+    def build_net(self) -> tuple[Layer, tuple]:
+        raise NotImplementedError
+
+    def init_params(self, rng):
+        params, state, out_shape = self.net.init(rng, self.in_shape)
+        self._out_shape = out_shape
+        return params, state
+
+    def loss_fn(self, params, state, batch, rng, train: bool):
+        x = batch["x"]
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(self.precision.compute_dtype)  # int tokens stay int
+        compute_params = self.precision.cast_to_compute(params)
+        logits, new_state = self.net.apply(
+            compute_params, state, x, train=train, rng=rng
+        )
+        loss = softmax_cross_entropy(logits, batch["y"])
+        if self.config.get("l2", 0.0):
+            # reference models folded L2 into the graph cost; weight_decay on
+            # the optimizer is the decoupled alternative
+            sq = sum(
+                jnp.sum(jnp.square(p.astype(jnp.float32)))
+                for p in jax.tree.leaves(params)
+            )
+            loss = loss + self.config["l2"] * sq
+        metrics = {
+            "cost": loss,
+            "error": top_k_error(logits, batch["y"], k=1),
+            "error_top5": top_k_error(logits, batch["y"], k=5)
+            if logits.shape[-1] >= 5
+            else jnp.zeros((), jnp.float32),
+        }
+        return loss, (new_state, metrics)

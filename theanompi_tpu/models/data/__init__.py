@@ -1,0 +1,12 @@
+"""Data layer: dataset classes + the parallel-loading pipeline.
+
+Reference (unverified — SURVEY.md §2.1): ``theanompi/models/data/`` —
+``imagenet.py`` (hickle ``.hkl`` shard lists, mean subtraction, crop+mirror),
+``cifar10.py`` (in-memory), ``proc_load_mpi.py`` (spawned loader process
+overlapping augmentation with GPU compute, the "para_load" protocol).
+"""
+
+from theanompi_tpu.models.data.base import Dataset, SyntheticDataset
+from theanompi_tpu.models.data.cifar10 import Cifar10Data
+
+__all__ = ["Dataset", "SyntheticDataset", "Cifar10Data"]

@@ -1,0 +1,139 @@
+"""Dataset protocol + deterministic synthetic datasets.
+
+All iterators yield **global** batches (the full cross-replica batch) as
+numpy dicts ``{"x": [B, ...], "y": [B]}`` with constant shapes — the rule's
+trainer shards the leading dim over the ``data`` mesh axis and jit requires
+static shapes, so ragged final batches are dropped (the reference did the
+same via ``file_batch_size`` bookkeeping; SURVEY.md §2.3, unverified).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Dataset:
+    """Duck-typed dataset: n_train/n_val counts + batch iterators."""
+
+    n_train: int
+    n_val: int
+    sample_shape: tuple
+    n_classes: int
+
+    def n_train_batches(self, batch_size: int) -> int:
+        return self.n_train // batch_size
+
+    def n_val_batches(self, batch_size: int) -> int:
+        return self.n_val // batch_size
+
+    def train_batches(self, batch_size: int, epoch: int, seed: int = 0):
+        raise NotImplementedError
+
+    def val_batches(self, batch_size: int):
+        raise NotImplementedError
+
+    def cleanup(self) -> None:
+        pass
+
+
+class ArrayDataset(Dataset):
+    """In-memory arrays with per-epoch shuffling and optional augmentation."""
+
+    def __init__(self, x_train, y_train, x_val, y_val, n_classes,
+                 augment_fn=None):
+        self.x_train, self.y_train = x_train, y_train
+        self.x_val, self.y_val = x_val, y_val
+        self.n_train, self.n_val = len(x_train), len(x_val)
+        self.sample_shape = tuple(x_train.shape[1:])
+        self.n_classes = n_classes
+        self.augment_fn = augment_fn
+
+    def train_batches(self, batch_size, epoch, seed=0):
+        rng = np.random.RandomState(hash((seed, epoch)) % (2**31))
+        order = rng.permutation(self.n_train)
+        for i in range(self.n_train_batches(batch_size)):
+            idx = order[i * batch_size : (i + 1) * batch_size]
+            x = self.x_train[idx]
+            if self.augment_fn is not None:
+                x = self.augment_fn(x, rng)
+            yield {"x": x, "y": self.y_train[idx]}
+
+    def val_batches(self, batch_size):
+        for i in range(self.n_val_batches(batch_size)):
+            sl = slice(i * batch_size, (i + 1) * batch_size)
+            yield {"x": self.x_val[sl], "y": self.y_val[sl]}
+
+
+def _class_structured(n, shape, n_classes, seed, noise=0.3, means_seed=0):
+    """Learnable synthetic data: one Gaussian blob per class.
+
+    Gives tests/benchmarks something a model can actually fit, so "loss
+    decreases" is a meaningful assertion — stand-in for the real datasets in
+    this zero-egress environment (real data plugs in via the same classes).
+    ``means_seed`` fixes the class means independently of the sample draw so
+    train and val splits share one distribution.
+    """
+    dim = int(np.prod(shape))
+    means = np.random.RandomState(means_seed).randn(n_classes, dim).astype(np.float32)
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, n_classes, size=n).astype(np.int32)
+    x = means[y] + noise * rng.randn(n, dim).astype(np.float32)
+    return x.reshape(n, *shape), y
+
+
+class SyntheticDataset(ArrayDataset):
+    def __init__(self, n_train=1024, n_val=256, sample_shape=(8, 8, 3),
+                 n_classes=10, seed=0, noise=0.3):
+        xt, yt = _class_structured(
+            n_train, sample_shape, n_classes, seed, noise, means_seed=seed
+        )
+        xv, yv = _class_structured(
+            n_val, sample_shape, n_classes, seed + 1, noise, means_seed=seed
+        )
+        super().__init__(xt, yt, xv, yv, n_classes)
+
+
+class SyntheticSequenceDataset(Dataset):
+    """Synthetic token streams for LM models (PTB stand-in).
+
+    Sequences follow a fixed random bigram table so there is real structure
+    to learn (perplexity can drop well below vocab size).
+    """
+
+    def __init__(self, n_train=512, n_val=128, seq_len=32, vocab=64, seed=0):
+        rng = np.random.RandomState(seed)
+        self.vocab = vocab
+        self.n_classes = vocab
+        self.seq_len = seq_len
+        self.sample_shape = (seq_len,)
+        # peaked bigram transition table
+        logits = rng.randn(vocab, vocab) * 2.0
+        probs = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+        self._probs = probs
+
+        def gen(n, r):
+            seqs = np.zeros((n, seq_len + 1), np.int32)
+            seqs[:, 0] = r.randint(0, vocab, n)
+            for t in range(seq_len):
+                cur = seqs[:, t]
+                u = r.rand(n, 1)
+                cdf = probs[cur].cumsum(1)
+                seqs[:, t + 1] = (u > cdf).sum(1)
+            return seqs
+
+        self._train = gen(n_train, np.random.RandomState(seed + 1))
+        self._val = gen(n_val, np.random.RandomState(seed + 2))
+        self.n_train, self.n_val = n_train, n_val
+
+    def train_batches(self, batch_size, epoch, seed=0):
+        rng = np.random.RandomState(hash((seed, epoch)) % (2**31))
+        order = rng.permutation(self.n_train)
+        for i in range(self.n_train // batch_size):
+            idx = order[i * batch_size : (i + 1) * batch_size]
+            s = self._train[idx]
+            yield {"x": s[:, :-1], "y": s[:, 1:]}
+
+    def val_batches(self, batch_size):
+        for i in range(self.n_val // batch_size):
+            s = self._val[i * batch_size : (i + 1) * batch_size]
+            yield {"x": s[:, :-1], "y": s[:, 1:]}

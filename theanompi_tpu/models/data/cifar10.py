@@ -1,0 +1,76 @@
+"""CIFAR-10 dataset.
+
+Reference (unverified — SURVEY.md §2.1): ``theanompi/models/data/cifar10.py``
+— in-memory CIFAR-10 with per-worker sharding, mean subtraction; crop/mirror
+augmentation came from the shared loader utilities.
+
+Real data loads from an ``.npz`` (keys ``x_train``/``y_train``/``x_test``/
+``y_test``, uint8 NHWC) found via ``config['data_path']`` or
+``$CIFAR10_PATH``; in this zero-egress image a class-structured synthetic
+stand-in of the same shape is generated instead, so the full pipeline
+(normalize → augment → shard → train) is exercised identically.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from theanompi_tpu.models.data.base import ArrayDataset, _class_structured
+
+MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+
+
+def pad_crop_mirror(x: np.ndarray, rng: np.random.RandomState, pad: int = 4):
+    """Random pad-crop + horizontal mirror (the reference's augmentations).
+
+    Host-side numpy, currently synchronous with the train loop; the
+    para_load-equivalent prefetch thread (planned, see
+    ``theanompi_tpu/models/data/__init__.py``) will move it off the critical
+    path.
+    """
+    n, h, w, c = x.shape
+    padded = np.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="reflect")
+    out = np.empty_like(x)
+    ys = rng.randint(0, 2 * pad + 1, n)
+    xs = rng.randint(0, 2 * pad + 1, n)
+    flips = rng.rand(n) < 0.5
+    for i in range(n):
+        img = padded[i, ys[i] : ys[i] + h, xs[i] : xs[i] + w]
+        out[i] = img[:, ::-1] if flips[i] else img
+    return out
+
+
+class Cifar10Data(ArrayDataset):
+    def __init__(self, config: dict | None = None):
+        config = config or {}
+        path = config.get("data_path") or os.environ.get("CIFAR10_PATH")
+        n_train = config.get("n_train", 2048)  # synthetic default size
+        n_val = config.get("n_val", 512)
+        if path and os.path.exists(path):
+            raw = np.load(path)
+            xt = raw["x_train"].astype(np.float32) / 255.0
+            xv = raw["x_test"].astype(np.float32) / 255.0
+            yt = raw["y_train"].reshape(-1).astype(np.int32)
+            yv = raw["y_test"].reshape(-1).astype(np.int32)
+            self.synthetic = False
+        else:
+            xt, yt = _class_structured(
+                n_train, (32, 32, 3), 10, seed=0, noise=0.5, means_seed=0
+            )
+            xv, yv = _class_structured(
+                n_val, (32, 32, 3), 10, seed=1, noise=0.5, means_seed=0
+            )
+            # shift into [0,1]-ish range so normalization below is meaningful
+            xt = 0.5 + 0.1 * xt
+            xv = 0.5 + 0.1 * xv
+            self.synthetic = True
+        xt = (xt - MEAN) / STD
+        xv = (xv - MEAN) / STD
+        augment = pad_crop_mirror if config.get("augment", True) else None
+        super().__init__(
+            xt.astype(np.float32), yt, xv.astype(np.float32), yv,
+            n_classes=10, augment_fn=augment,
+        )

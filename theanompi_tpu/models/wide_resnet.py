@@ -1,0 +1,121 @@
+"""Wide-ResNet for CIFAR-10 (BASELINE.md config 1 — the CPU-testable slice).
+
+Reference (unverified — SURVEY.md §2.1): ``theanompi/models/wide_resnet.py``,
+a fork addition per BASELINE.json; WRN-d-k follows Zagoruyko & Komodakis 2016
+(pre-activation BN-ReLU-Conv blocks, three stages, global average pool).
+
+Config: ``depth`` (6n+4), ``widen`` (k), standard WRN-16-4 by default; tests
+use a tiny variant.  Sync-BN across the data axis is on by default under
+multi-worker rules (``bn_axis``), fixing the reference's per-GPU BN drift.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.models.contract import SupervisedModel
+from theanompi_tpu.models.data.cifar10 import Cifar10Data
+from theanompi_tpu.ops import layers as L
+from theanompi_tpu.ops import initializers as init_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class _WRNBlock(L.Layer):
+    """Pre-activation residual block: BN-ReLU-Conv ×2 (+ projection)."""
+
+    filters: int
+    stride: int = 1
+    bn_axis: str | None = None
+
+    def _sub(self):
+        return (
+            L.BatchNorm(axis_name=self.bn_axis),
+            L.Conv2D(self.filters, 3, stride=self.stride, use_bias=False),
+            L.BatchNorm(axis_name=self.bn_axis),
+            L.Conv2D(self.filters, 3, use_bias=False),
+        )
+
+    def init(self, key, in_shape):
+        bn1, conv1, bn2, conv2 = self._sub()
+        keys = jax.random.split(key, 5)
+        params, state = {}, {}
+        shape = in_shape
+        for name, layer, k in (
+            ("bn1", bn1, keys[0]), ("conv1", conv1, keys[1]),
+            ("bn2", bn2, keys[2]), ("conv2", conv2, keys[3]),
+        ):
+            p, s, shape = layer.init(k, shape)
+            if p:
+                params[name] = p
+            if s:
+                state[name] = s
+        if in_shape[-1] != self.filters or self.stride != 1:
+            proj = L.Conv2D(self.filters, 1, stride=self.stride, use_bias=False)
+            p, _, _ = proj.init(keys[4], in_shape)
+            params["proj"] = p
+        return params, state, shape
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        bn1, conv1, bn2, conv2 = self._sub()
+        new_state = dict(state)
+        h, s = bn1.apply(params["bn1"], state["bn1"], x, train=train)
+        new_state["bn1"] = s
+        h = jax.nn.relu(h)
+        shortcut = x
+        if "proj" in params:
+            proj = L.Conv2D(self.filters, 1, stride=self.stride, use_bias=False)
+            shortcut, _ = proj.apply(params["proj"], {}, h)
+        h, _ = conv1.apply(params["conv1"], {}, h)
+        h, s = bn2.apply(params["bn2"], state["bn2"], h, train=train)
+        new_state["bn2"] = s
+        h = jax.nn.relu(h)
+        h, _ = conv2.apply(params["conv2"], {}, h)
+        return h + shortcut, new_state
+
+
+class WideResNet(SupervisedModel):
+    """WRN-depth-widen on CIFAR-10."""
+
+    default_config = {
+        "depth": 16,
+        "widen": 4,
+        "batch_size": 128,
+        "n_epochs": 60,
+        "lr": 0.1,
+        "lr_decay_epochs": (30, 45),
+        "lr_decay_factor": 0.2,
+        "momentum": 0.9,
+        "weight_decay": 5e-4,
+        "nesterov": True,
+        "image_size": 32,
+        "bn_axis": None,  # set to "data" by multi-worker rules for sync-BN
+    }
+
+    def build_data(self):
+        return Cifar10Data(self.config)
+
+    def build_net(self):
+        cfg = self.config
+        depth, k = cfg["depth"], cfg["widen"]
+        if (depth - 4) % 6 != 0:
+            raise ValueError("WRN depth must be 6n+4")
+        n = (depth - 4) // 6
+        bn_axis = cfg["bn_axis"]
+        widths = [16, 16 * k, 32 * k, 64 * k]
+        layers: list[L.Layer] = [L.Conv2D(widths[0], 3, use_bias=False)]
+        for stage, width in enumerate(widths[1:]):
+            for i in range(n):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                layers.append(_WRNBlock(width, stride=stride, bn_axis=bn_axis))
+        layers += [
+            L.BatchNorm(axis_name=bn_axis),
+            L.Activation("relu"),
+            L.GlobalAvgPool(),
+            L.Dense(self.data.n_classes, w_init=init_lib.glorot_normal),
+        ]
+        s = cfg["image_size"]
+        return L.Sequential(layers), (s, s, 3)

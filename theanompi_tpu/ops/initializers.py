@@ -1,0 +1,92 @@
+"""Weight initializers.
+
+Reference (unverified — SURVEY.md §2.1): the ``Weight`` class in
+``theanompi/models/layers2.py`` bundled init schemes (gaussian std-0.01 for
+AlexNet-era nets, Xavier/He for the deeper zoo) with save/load.  Here
+initializers are plain ``fn(key, shape, dtype) -> array``; persistence lives
+in :mod:`theanompi_tpu.utils.checkpoint`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape):
+    """(fan_in, fan_out) for dense [in, out] and conv HWIO kernels."""
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    receptive = int(np.prod(shape[:-2])) if len(shape) > 2 else 1
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def zeros(key, shape, dtype=jnp.float32):
+    del key
+    return jnp.zeros(shape, dtype)
+
+
+def ones(key, shape, dtype=jnp.float32):
+    del key
+    return jnp.ones(shape, dtype)
+
+
+def constant(value):
+    def init(key, shape, dtype=jnp.float32):
+        del key
+        return jnp.full(shape, value, dtype)
+
+    return init
+
+
+def normal(stddev=0.01, mean=0.0):
+    """Plain gaussian — the AlexNet-era default scheme."""
+
+    def init(key, shape, dtype=jnp.float32):
+        return mean + stddev * jax.random.normal(key, shape, dtype)
+
+    return init
+
+
+def uniform(scale=0.01):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, -scale, scale)
+
+    return init
+
+
+def he_normal(key, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    return jax.random.normal(key, shape, dtype) * jnp.sqrt(2.0 / fan_in)
+
+
+def glorot_normal(key, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    return jax.random.normal(key, shape, dtype) * jnp.sqrt(2.0 / (fan_in + fan_out))
+
+
+def glorot_uniform(key, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    limit = jnp.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+def orthogonal(scale=1.0):
+    """Orthogonal init (LSTM recurrent kernels)."""
+
+    def init(key, shape, dtype=jnp.float32):
+        if len(shape) < 2:
+            raise ValueError("orthogonal init needs >= 2 dims")
+        rows = int(np.prod(shape[:-1]))
+        cols = shape[-1]
+        mat = jax.random.normal(key, (max(rows, cols), min(rows, cols)), dtype)
+        q, r = jnp.linalg.qr(mat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        q = q.T if rows < cols else q
+        return scale * q[:rows, :cols].reshape(shape)
+
+    return init

@@ -1,0 +1,41 @@
+"""Losses and error metrics.
+
+Reference (unverified — SURVEY.md §2.1): the ``Softmax`` layer in
+``theanompi/models/layers2.py`` fused log-softmax + NLL and reported
+categorical error; top-1/top-5 error tracked AlexNet-paper metrics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean cross entropy; ``labels`` are int class ids ``[B]`` (or ``[B,T]``).
+
+    Computed in fp32 regardless of logits dtype — softmax in bf16 loses the
+    small-probability tail and destabilizes late training.
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def sigmoid_binary_cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean BCE on raw logits (DCGAN discriminator/generator losses)."""
+    logits = logits.astype(jnp.float32)
+    targets = targets.astype(jnp.float32)
+    return jnp.mean(
+        jnp.maximum(logits, 0.0) - logits * targets + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )
+
+
+def top_k_error(logits: jax.Array, labels: jax.Array, k: int = 1) -> jax.Array:
+    """Fraction of examples whose label is NOT in the top-k predictions."""
+    gold = jnp.take_along_axis(
+        logits, labels[..., None], axis=-1
+    )
+    rank = jnp.sum(logits > gold, axis=-1)  # number of classes scored higher
+    return jnp.mean((rank >= k).astype(jnp.float32))

@@ -1,0 +1,119 @@
+"""Optimizers: pytree-based SGD family + Adam/RMSProp.
+
+Reference (unverified — SURVEY.md §2.1): ``theanompi/lib/opt.py`` built Theano
+update lists — vanilla/momentum/Nesterov SGD with optional L2, and the
+BSP-specific cumulative-gradient variants.  Here an optimizer is an immutable
+object with ``init(params) -> opt_state`` and
+``update(grads, opt_state, params, lr) -> (new_params, new_opt_state)``; both
+are pure and run inside the compiled train step, so the whole update fuses
+into the step's HLO.  ``lr`` is a traced scalar → epoch-wise LR schedules
+(``adjust_hyperp``) never trigger recompilation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+def _tmap(fn, *trees):
+    return jax.tree.map(fn, *trees)
+
+
+class Optimizer:
+    def init(self, params):
+        raise NotImplementedError
+
+    def update(self, grads, opt_state, params, lr):
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class SGD(Optimizer):
+    """Vanilla / momentum / Nesterov SGD with optional L2 weight decay.
+
+    ``momentum=0`` → vanilla; ``nesterov=True`` matches the reference's
+    Nesterov formulation (lookahead applied to the update, not the gradient).
+    """
+
+    momentum: float = 0.0
+    nesterov: bool = False
+    weight_decay: float = 0.0
+
+    def init(self, params):
+        if self.momentum == 0.0:
+            return {}
+        return {"velocity": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, opt_state, params, lr):
+        if self.weight_decay:
+            grads = _tmap(lambda g, p: g + self.weight_decay * p, grads, params)
+        if self.momentum == 0.0:
+            new_params = _tmap(lambda p, g: p - lr * g, params, grads)
+            return new_params, opt_state
+        vel = _tmap(
+            lambda v, g: self.momentum * v - lr * g, opt_state["velocity"], grads
+        )
+        if self.nesterov:
+            step = _tmap(lambda v, g: self.momentum * v - lr * g, vel, grads)
+        else:
+            step = vel
+        new_params = _tmap(lambda p, s: p + s, params, step)
+        return new_params, {"velocity": vel}
+
+
+@dataclasses.dataclass(frozen=True)
+class Adam(Optimizer):
+    """Adam (DCGAN per the original paper: lr=2e-4, b1=0.5)."""
+
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+
+    def init(self, params):
+        return {
+            "m": _tmap(jnp.zeros_like, params),
+            "v": _tmap(jnp.zeros_like, params),
+            "t": jnp.zeros((), jnp.int32),
+        }
+
+    def update(self, grads, opt_state, params, lr):
+        if self.weight_decay:
+            grads = _tmap(lambda g, p: g + self.weight_decay * p, grads, params)
+        t = opt_state["t"] + 1
+        m = _tmap(lambda m, g: self.b1 * m + (1 - self.b1) * g, opt_state["m"], grads)
+        v = _tmap(
+            lambda v, g: self.b2 * v + (1 - self.b2) * jnp.square(g),
+            opt_state["v"], grads,
+        )
+        tf = t.astype(jnp.float32)
+        scale = jnp.sqrt(1 - self.b2**tf) / (1 - self.b1**tf)
+        new_params = _tmap(
+            lambda p, m_, v_: p - lr * scale * m_ / (jnp.sqrt(v_) + self.eps),
+            params, m, v,
+        )
+        return new_params, {"m": m, "v": v, "t": t}
+
+
+@dataclasses.dataclass(frozen=True)
+class RMSProp(Optimizer):
+    """RMSProp (WGAN per the original paper: lr=5e-5)."""
+
+    decay: float = 0.9
+    eps: float = 1e-8
+
+    def init(self, params):
+        return {"sq": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, opt_state, params, lr):
+        sq = _tmap(
+            lambda s, g: self.decay * s + (1 - self.decay) * jnp.square(g),
+            opt_state["sq"], grads,
+        )
+        new_params = _tmap(
+            lambda p, g, s: p - lr * g / (jnp.sqrt(s) + self.eps), params, grads, sq
+        )
+        return new_params, {"sq": sq}

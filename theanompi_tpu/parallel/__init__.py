@@ -12,6 +12,7 @@ from theanompi_tpu.parallel.mesh import (
     Precision,
     make_mesh,
     replica_rng,
+    shard_map,
 )
 from theanompi_tpu.parallel.exchanger import Exchanger, STRATEGIES
 
@@ -21,6 +22,7 @@ __all__ = [
     "Precision",
     "make_mesh",
     "replica_rng",
+    "shard_map",
     "Exchanger",
     "STRATEGIES",
 ]

@@ -1,0 +1,256 @@
+"""BSP: synchronous data-parallel training (the reference's flagship rule).
+
+Reference (unverified — SURVEY.md §2.1/§3.2): ``theanompi/__init__.py`` class
+``BSP`` (``init(devices, modelfile, modelclass)`` composing an mpirun command,
+``wait()`` joining) and ``bsp_worker.py`` (per-process train loop: τ=1
+exchange of gradients/params per batch via ``BSP_Exchanger``, per-epoch
+validation, LR schedule, rank-0 recording).
+
+TPU-native re-expression — no processes, no mpirun: one controller traces a
+single train step; ``shard_map`` over the ``data`` mesh axis makes XLA run it
+SPMD on every chip with the exchanger's collective compiled *into* the step.
+What was "N worker processes each calling train_fn then MPI.Allreduce"
+becomes one jitted function whose HLO contains the all-reduce — XLA overlaps
+it with the backward pass where dependencies allow, which is the optimization
+the reference's exchanger strategies chased by hand.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from theanompi_tpu.parallel.exchanger import Exchanger
+from theanompi_tpu.parallel.mesh import (
+    DATA_AXIS,
+    make_mesh,
+    replica_rng,
+    shard_map,
+)
+from theanompi_tpu.utils.helper_funcs import import_model, replicate, shard_batch
+from theanompi_tpu.utils.recorder import Recorder
+
+
+def _pmean_floats(tree, axis_name):
+    def f(x):
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+            return lax.pmean(x, axis_name)
+        return x
+
+    return jax.tree.map(f, tree)
+
+
+class BSPTrainer:
+    """Compiles and drives the BSP step for one model on one mesh.
+
+    Owns the reference worker's ``compile_iter_fns``/``train_iter``/
+    ``val_iter`` responsibilities (SURVEY.md §2.3); the model supplies the
+    pure functions.
+    """
+
+    def __init__(
+        self,
+        model,
+        mesh=None,
+        exch_strategy: str = "psum",
+        recorder: Recorder | None = None,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.mesh = mesh if mesh is not None else make_mesh(n_data=1)
+        self.n_workers = self.mesh.shape[DATA_AXIS]
+        self.exchanger = Exchanger(strategy=exch_strategy)
+        self.recorder = recorder or Recorder()
+        self.seed = seed
+        self.optimizer = model.build_optimizer()
+        self.global_batch = model.batch_size * self.n_workers
+        self._step_fn = None
+        self._eval_fn = None
+        self.params = None
+        self.state = None
+        self.opt_state = None
+        self.epoch = 0
+        self.iteration = 0
+
+    # -- compilation --------------------------------------------------------
+    def compile_iter_fns(self) -> None:
+        """Build + jit the train/eval steps (reference method name)."""
+        model, mesh, ex, opt = self.model, self.mesh, self.exchanger, self.optimizer
+        base_key = jax.random.PRNGKey(self.seed)
+
+        def local_step(params, state, opt_state, batch, lr, step):
+            rng = replica_rng(jax.random.fold_in(base_key, step), DATA_AXIS)
+
+            def lossw(p):
+                return model.loss_fn(p, state, batch, rng, train=True)
+
+            (_, (new_state, metrics)), grads = jax.value_and_grad(
+                lossw, has_aux=True
+            )(params)
+            grads = ex.exchange(grads)
+            new_params, new_opt_state = opt.update(grads, opt_state, params, lr)
+            metrics = _pmean_floats(metrics, DATA_AXIS)
+            # keep non-learned state consistent across replicas (already
+            # identical under sync-BN; pmean repairs drift otherwise)
+            new_state = _pmean_floats(new_state, DATA_AXIS)
+            return new_params, new_state, new_opt_state, metrics
+
+        def local_eval(params, state, batch):
+            _, (_, metrics) = model.loss_fn(params, state, batch, None, train=False)
+            return _pmean_floats(metrics, DATA_AXIS)
+
+        self._step_fn = jax.jit(
+            shard_map(
+                local_step,
+                self.mesh,
+                in_specs=(P(), P(), P(), P(DATA_AXIS), P(), P()),
+                out_specs=(P(), P(), P(), P()),
+            ),
+            donate_argnums=(0, 1, 2),
+        )
+        self._eval_fn = jax.jit(
+            shard_map(
+                local_eval,
+                self.mesh,
+                in_specs=(P(), P(), P(DATA_AXIS)),
+                out_specs=P(),
+            )
+        )
+
+    def init_state(self) -> None:
+        params, state = self.model.init_params(jax.random.PRNGKey(self.seed + 1))
+        self.params = replicate(self.mesh, params)
+        self.state = replicate(self.mesh, state)
+        self.opt_state = replicate(self.mesh, self.optimizer.init(params))
+
+    # -- iteration (reference train_iter/val_iter) --------------------------
+    def train_iter(self, batch: dict, lr: float, recorder: Recorder | None = None):
+        r = recorder or self.recorder
+        r.start("wait")
+        batch = shard_batch(self.mesh, batch)
+        r.end("wait")
+        r.start("calc")
+        self.params, self.state, self.opt_state, metrics = self._step_fn(
+            self.params,
+            self.state,
+            self.opt_state,
+            batch,
+            jnp.float32(lr),
+            jnp.int32(self.iteration),
+        )
+        self.iteration += 1
+        # fence only at print boundaries: per-iter blocking would serialize
+        # the dispatch pipeline (SURVEY.md §7 hard part 5)
+        fence = (
+            metrics["cost"]
+            if self.iteration % r.print_freq == 0
+            else None
+        )
+        r.end("calc", fence=fence)
+        r.end_iteration()
+        r.train_metrics(**metrics)
+        r.print_train_info(self.iteration)
+        return metrics
+
+    def val_iter(self, batch: dict, recorder: Recorder | None = None):
+        batch = shard_batch(self.mesh, batch)
+        return self._eval_fn(self.params, self.state, batch)
+
+    def validate(self, epoch: int):
+        accums: dict[str, list] = {}
+        for batch in self.model.data.val_batches(self.global_batch):
+            m = self.val_iter(batch)
+            for k, v in m.items():
+                accums.setdefault(k, []).append(v)
+        means = {k: float(np.mean([float(x) for x in v])) for k, v in accums.items()}
+        self.recorder.val_metrics(epoch, **means)
+        return means
+
+    # -- full run (reference BSP_Worker.run) --------------------------------
+    def run(self):
+        if self._step_fn is None:
+            self.compile_iter_fns()
+        if self.params is None:
+            self.init_state()
+        model = self.model
+        for epoch in range(self.epoch, model.n_epochs):
+            self.epoch = epoch
+            self.recorder.start_epoch()
+            lr = model.adjust_hyperp(epoch)
+            for batch in model.data.train_batches(
+                self.global_batch, epoch, seed=self.seed
+            ):
+                self.train_iter(batch, lr)
+            self.validate(epoch)
+        self.recorder.save()
+        model.cleanup()
+        return self.recorder
+
+
+class BSP:
+    """Reference-compatible rule facade.
+
+    Usage (mirrors the reference README pattern, SURVEY.md §3.1)::
+
+        rule = BSP(config={"exch_strategy": "psum"})
+        rule.init(devices=8, modelfile="theanompi_tpu.models.wide_resnet",
+                  modelclass="WideResNet")
+        rule.wait()
+
+    ``devices`` is a worker count, a list of jax devices, or None (all
+    devices).  ``init`` builds the mesh and compiles; ``wait`` runs training
+    to completion and returns the recorder (there is no process tree to join
+    — the "cluster" is the mesh).
+    """
+
+    def __init__(self, config: dict[str, Any] | None = None):
+        self.config = config or {}
+        self.trainer: BSPTrainer | None = None
+
+    def init(
+        self,
+        devices=None,
+        modelfile: str = "theanompi_tpu.models.wide_resnet",
+        modelclass: str = "WideResNet",
+        model_config: dict | None = None,
+    ) -> "BSP":
+        if isinstance(devices, int):
+            mesh = make_mesh(n_data=devices, devices=jax.devices()[:devices])
+        elif devices is None:
+            mesh = make_mesh()
+        else:
+            mesh = make_mesh(n_data=len(devices), devices=devices)
+        n = mesh.shape[DATA_AXIS]
+        model_config = dict(model_config or {})
+        if n > 1:
+            # multi-worker: cross-replica BN statistics by default
+            model_config.setdefault("bn_axis", DATA_AXIS)
+        model_cls = import_model(modelfile, modelclass)
+        model = model_cls(model_config)
+        self.trainer = BSPTrainer(
+            model,
+            mesh=mesh,
+            exch_strategy=self.config.get("exch_strategy", "psum"),
+            recorder=Recorder(
+                print_freq=self.config.get("print_freq", 40),
+                save_dir=self.config.get("record_dir"),
+                verbose=self.config.get("verbose", model.verbose),
+            ),
+            seed=self.config.get("seed", 0),
+        )
+        self.trainer.compile_iter_fns()
+        self.trainer.init_state()
+        return self
+
+    def wait(self):
+        """Run training to completion (reference: join the mpirun tree)."""
+        if self.trainer is None:
+            raise RuntimeError("call init() before wait()")
+        return self.trainer.run()

@@ -57,8 +57,10 @@ def _psum_mean(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
 def _psum_bf16_mean(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
     """bf16-compressed all-reduce (reference ``asa16``/``nccl16``).
 
-    Halves ICI bytes; the mean is taken in fp32 after decompression to avoid
-    bf16 accumulation error growing with worker count.
+    Halves ICI bytes.  Note the accumulation itself is bf16 (XLA reduces in
+    the wire dtype), so rounding error grows ~O(n) with worker count exactly
+    as the reference's fp16 strategies' did; only the final mean division is
+    fp32.  Use plain ``psum`` when numerics matter more than bandwidth.
     """
     if not jnp.issubdtype(x.dtype, jnp.floating):
         return _psum_mean(x, axis_name, axis_size)

@@ -1,0 +1,9 @@
+"""Utilities: recorder, checkpointing, helper functions.
+
+Reference (unverified — SURVEY.md §2.1): ``theanompi/lib/recorder.py`` and
+``theanompi/lib/helper_funcs.py``.
+"""
+
+from theanompi_tpu.utils.recorder import Recorder
+
+__all__ = ["Recorder"]

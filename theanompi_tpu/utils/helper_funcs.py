@@ -1,0 +1,63 @@
+"""Small helpers: model import-by-string, batch sharding, pytree utilities.
+
+Reference (unverified — SURVEY.md §2.1): ``theanompi/lib/helper_funcs.py``
+(``bufint`` gpuarray→MPI buffer views, ``dtype_to_mpi``, weight save/load).
+The buffer plumbing has no TPU equivalent — XLA owns device buffers — so what
+remains is model loading (reference ``lib/base.py`` imported the model module
+by name on each worker) and host→device placement.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from theanompi_tpu.parallel.mesh import DATA_AXIS
+
+
+def import_model(modelfile: str, modelclass: str):
+    """Resolve a model class from ``modelfile`` (module path) + class name.
+
+    Mirrors the reference's launch contract:
+    ``BSP.init(devices, modelfile='theanompi.models.alex_net',
+    modelclass='AlexNet')``.
+    """
+    mod = importlib.import_module(modelfile)
+    try:
+        return getattr(mod, modelclass)
+    except AttributeError as e:
+        raise AttributeError(
+            f"module {modelfile!r} has no class {modelclass!r}"
+        ) from e
+
+
+def shard_batch(mesh: Mesh, batch: dict, axis: str = DATA_AXIS) -> dict:
+    """Place a host batch on the mesh, leading dim split over ``axis``."""
+
+    def put(x):
+        x = np.asarray(x)
+        spec = P(axis, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, batch)
+
+
+def replicate(mesh: Mesh, tree):
+    """Replicate a pytree across every device of the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def tree_bytes(tree) -> int:
+    return sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree.leaves(tree)
+        if hasattr(x, "size")
+    )
+
+
+def tree_count(tree) -> int:
+    return sum(x.size for x in jax.tree.leaves(tree) if hasattr(x, "size"))

@@ -1,0 +1,151 @@
+"""Training recorder: per-iteration wall-clock splits + metric histories.
+
+Reference (unverified — SURVEY.md §2.1/§5): ``theanompi/lib/recorder.py`` —
+``Recorder.start/end`` wall-clock segments (calc / comm / wait) threaded
+through ``train_iter``/``exchange``, train cost+error printed every N
+iterations, epoch validation stats, ``.npy`` histories dumped to a record
+dir.  The API is preserved; the TPU twist is honesty under async dispatch:
+jax returns control before the device finishes, so ``end()`` accepts a
+``fence`` array to ``block_until_ready`` — without it the calc/comm split is
+meaningless (SURVEY.md §7 hard part 5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import defaultdict
+
+import numpy as np
+
+SEGMENTS = ("wait", "calc", "comm")
+
+
+class Recorder:
+    def __init__(self, print_freq: int = 40, save_dir: str | None = None,
+                 rank: int = 0, verbose: bool = True):
+        self.print_freq = print_freq
+        self.save_dir = save_dir
+        self.verbose = verbose and rank == 0
+        self._t0: dict[str, float] = {}
+        self._iter_times: dict[str, float] = defaultdict(float)
+        self.time_history: dict[str, list] = defaultdict(list)
+        self.train_history: dict[str, list] = defaultdict(list)
+        self.val_history: dict[str, list] = defaultdict(list)
+        self._train_accum: dict[str, list] = defaultdict(list)
+        self.epoch_start_time: float | None = None
+
+    # -- wall-clock segments ------------------------------------------------
+    def start(self, what: str = "calc") -> None:
+        self._t0[what] = time.perf_counter()
+
+    def end(self, what: str = "calc", fence=None) -> None:
+        """Close segment ``what``; pass a jax array as ``fence`` to block on
+        device completion so the split reflects device time, not dispatch."""
+        if fence is not None:
+            import jax
+
+            # no exception guard: an async device error surfacing at the
+            # fence (the one deliberate sync point) must propagate here, not
+            # at some arbitrary later sync with a misleading stack
+            jax.block_until_ready(fence)
+        self._iter_times[what] += time.perf_counter() - self._t0.pop(what)
+
+    def end_iteration(self) -> None:
+        for seg in SEGMENTS:
+            self.time_history[seg].append(self._iter_times.get(seg, 0.0))
+        self._iter_times.clear()
+
+    # -- metrics ------------------------------------------------------------
+    def train_metrics(self, **metrics) -> None:
+        """Accumulate per-iteration metrics.
+
+        Values may be device arrays; conversion to host floats is deferred to
+        the print boundary so per-iteration recording never forces a device
+        sync (which would serialize the dispatch pipeline on TPU).
+        """
+        for k, v in metrics.items():
+            self._train_accum[k].append(v)
+
+    def print_train_info(self, count: int) -> None:
+        """Every ``print_freq`` iterations: averaged metrics + time split."""
+        if count % self.print_freq != 0 or not self._train_accum:
+            return
+        means = {
+            k: float(np.mean([float(x) for x in v]))
+            for k, v in self._train_accum.items()
+        }
+        for k, v in means.items():
+            self.train_history[k].append(v)
+        self.train_history["iter"].append(count)
+        if self.verbose:
+            metric_s = " ".join(f"{k} {v:.4f}" for k, v in means.items())
+            n = min(self.print_freq, len(self.time_history["calc"]) or 1)
+            times = {
+                seg: float(np.sum(self.time_history[seg][-n:]))
+                for seg in SEGMENTS
+            }
+            time_s = " ".join(f"{s} {t:.3f}s" for s, t in times.items())
+            print(f"iter {count}: {metric_s} | {time_s}", flush=True)
+        self._train_accum.clear()
+
+    def val_metrics(self, epoch: int, **metrics) -> None:
+        self.val_history["epoch"].append(epoch)
+        for k, v in metrics.items():
+            self.val_history[k].append(float(v))
+        if self.verbose:
+            metric_s = " ".join(f"val_{k} {float(v):.4f}" for k, v in metrics.items())
+            dur = (
+                f" ({time.perf_counter() - self.epoch_start_time:.1f}s)"
+                if self.epoch_start_time
+                else ""
+            )
+            print(f"epoch {epoch}: {metric_s}{dur}", flush=True)
+
+    def start_epoch(self) -> None:
+        self.epoch_start_time = time.perf_counter()
+
+    def latest_val(self, key: str = "cost"):
+        vals = self.val_history.get(key)
+        return vals[-1] if vals else None
+
+    # -- persistence (reference dumped .npy histories into record/) ---------
+    def save(self, path: str | None = None) -> None:
+        path = path or self.save_dir
+        if path is None:
+            return
+        os.makedirs(path, exist_ok=True)
+        for name, hist in (
+            ("time", self.time_history),
+            ("train", self.train_history),
+            ("val", self.val_history),
+        ):
+            np.save(
+                os.path.join(path, f"{name}_history.npy"),
+                {k: np.asarray(v) for k, v in hist.items()},
+                allow_pickle=True,
+            )
+        with open(os.path.join(path, "summary.json"), "w") as f:
+            json.dump(
+                {
+                    "iters": len(self.time_history["calc"]),
+                    "last_val": {
+                        k: v[-1] for k, v in self.val_history.items() if v
+                    },
+                },
+                f,
+            )
+
+    def load(self, path: str | None = None) -> None:
+        path = path or self.save_dir
+        for name, hist in (
+            ("time", self.time_history),
+            ("train", self.train_history),
+            ("val", self.val_history),
+        ):
+            p = os.path.join(path, f"{name}_history.npy")
+            if os.path.exists(p):
+                loaded = np.load(p, allow_pickle=True).item()
+                hist.clear()
+                hist.update({k: list(v) for k, v in loaded.items()})
