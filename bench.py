@@ -1,0 +1,89 @@
+"""Benchmark: flagship-model training throughput on the available hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference's primary metric (BASELINE.json) is ImageNet images/sec/chip
+under the BSP rule.  No published reference numbers were recoverable (the
+reference mount was empty — see BASELINE.md), so ``vs_baseline`` is the ratio
+to the round-1 nominal recorded below; it starts at 1.0 and tracks our own
+improvement across rounds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+
+# Round-1 nominal throughput (images/sec) per (model, platform) — the
+# denominator for vs_baseline.  Backfill real reference numbers if the
+# reference mount is ever fixed.
+NOMINAL = {
+    ("wide_resnet", "tpu"): 4000.0,
+    ("wide_resnet", "cpu"): 40.0,
+    ("resnet50", "tpu"): 800.0,
+    ("resnet50", "cpu"): 4.0,
+}
+
+
+def build_trainer(model_name: str):
+    from theanompi_tpu.parallel.bsp import BSPTrainer
+    from theanompi_tpu.parallel.mesh import make_mesh
+
+    if model_name == "resnet50":
+        from theanompi_tpu.models.resnet50 import ResNet50 as cls
+
+        cfg = {"batch_size": 64, "n_train": 256, "n_val": 64}
+    else:
+        from theanompi_tpu.models.wide_resnet import WideResNet as cls
+
+        cfg = {"batch_size": 256, "n_train": 1024, "n_val": 256}
+    model = cls(cfg)
+    mesh = make_mesh(n_data=1, devices=jax.devices()[:1])
+    trainer = BSPTrainer(model, mesh=mesh)
+    trainer.compile_iter_fns()
+    trainer.init_state()
+    return trainer, model
+
+
+def main():
+    model_name = os.environ.get("BENCH_MODEL", "resnet50")
+    try:
+        trainer, model = build_trainer(model_name)
+    except ImportError:
+        model_name = "wide_resnet"
+        trainer, model = build_trainer(model_name)
+    platform = jax.devices()[0].platform
+    steps = int(os.environ.get("BENCH_STEPS", "30" if platform == "tpu" else "10"))
+
+    batches = list(model.data.train_batches(trainer.global_batch, epoch=0, seed=0))
+    # warmup: trigger compile + first dispatch
+    for b in batches[:2]:
+        m = trainer.train_iter(b, lr=0.01)
+    jax.block_until_ready(m["cost"])
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        m = trainer.train_iter(batches[i % len(batches)], lr=0.01)
+    jax.block_until_ready(m["cost"])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = steps * trainer.global_batch / dt
+    base = NOMINAL.get((model_name, platform), images_per_sec)
+    print(
+        json.dumps(
+            {
+                "metric": f"{model_name}_train_images_per_sec_per_chip_{platform}",
+                "value": round(images_per_sec, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(images_per_sec / base, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

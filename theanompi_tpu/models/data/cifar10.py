@@ -49,6 +49,7 @@ class Cifar10Data(ArrayDataset):
         path = config.get("data_path") or os.environ.get("CIFAR10_PATH")
         n_train = config.get("n_train", 2048)  # synthetic default size
         n_val = config.get("n_val", 512)
+        s = config.get("image_size", 32)  # synthetic path only; real data is 32
         if path and os.path.exists(path):
             raw = np.load(path)
             xt = raw["x_train"].astype(np.float32) / 255.0
@@ -58,10 +59,10 @@ class Cifar10Data(ArrayDataset):
             self.synthetic = False
         else:
             xt, yt = _class_structured(
-                n_train, (32, 32, 3), 10, seed=0, noise=0.5, means_seed=0
+                n_train, (s, s, 3), 10, seed=0, noise=0.5, means_seed=0
             )
             xv, yv = _class_structured(
-                n_val, (32, 32, 3), 10, seed=1, noise=0.5, means_seed=0
+                n_val, (s, s, 3), 10, seed=1, noise=0.5, means_seed=0
             )
             # shift into [0,1]-ish range so normalization below is meaningful
             xt = 0.5 + 0.1 * xt

@@ -39,6 +39,9 @@ class _WRNBlock(L.Layer):
             L.Conv2D(self.filters, 3, use_bias=False),
         )
 
+    def _proj(self):
+        return L.Conv2D(self.filters, 1, stride=self.stride, use_bias=False)
+
     def init(self, key, in_shape):
         bn1, conv1, bn2, conv2 = self._sub()
         keys = jax.random.split(key, 5)
@@ -54,8 +57,7 @@ class _WRNBlock(L.Layer):
             if s:
                 state[name] = s
         if in_shape[-1] != self.filters or self.stride != 1:
-            proj = L.Conv2D(self.filters, 1, stride=self.stride, use_bias=False)
-            p, _, _ = proj.init(keys[4], in_shape)
+            p, _, _ = self._proj().init(keys[4], in_shape)
             params["proj"] = p
         return params, state, shape
 
@@ -67,8 +69,7 @@ class _WRNBlock(L.Layer):
         h = jax.nn.relu(h)
         shortcut = x
         if "proj" in params:
-            proj = L.Conv2D(self.filters, 1, stride=self.stride, use_bias=False)
-            shortcut, _ = proj.apply(params["proj"], {}, h)
+            shortcut, _ = self._proj().apply(params["proj"], {}, h)
         h, _ = conv1.apply(params["conv1"], {}, h)
         h, s = bn2.apply(params["bn2"], state["bn2"], h, train=train)
         new_state["bn2"] = s

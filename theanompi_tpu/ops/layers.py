@@ -325,7 +325,9 @@ class BatchNorm(Layer):
             if self.axis_name is not None:
                 mean = lax.pmean(mean, self.axis_name)
                 mean_sq = lax.pmean(mean_sq, self.axis_name)
-            var = mean_sq - jnp.square(mean)
+            # clamp: E[x^2]-E[x]^2 cancellation can go (slightly) negative in
+            # fp32 for large-mean activations, and rsqrt(negative+eps) is NaN
+            var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
             m = self.momentum
             new_state = {
                 "mean": m * state["mean"] + (1 - m) * mean,

@@ -37,5 +37,7 @@ def top_k_error(logits: jax.Array, labels: jax.Array, k: int = 1) -> jax.Array:
     gold = jnp.take_along_axis(
         logits, labels[..., None], axis=-1
     )
-    rank = jnp.sum(logits > gold, axis=-1)  # number of classes scored higher
+    # >= so ties score against the model: a collapsed constant-logit net must
+    # not report 0% error (the label's own logit is excluded by the -1)
+    rank = jnp.sum(logits >= gold, axis=-1) - 1
     return jnp.mean((rank >= k).astype(jnp.float32))

@@ -164,8 +164,20 @@ class BSPTrainer:
         return self._eval_fn(self.params, self.state, batch)
 
     def validate(self, epoch: int):
+        # the val set may be smaller than the global batch; shrink to the
+        # largest worker-divisible batch rather than silently skipping
+        vb = min(self.global_batch, self.model.data.n_val)
+        vb -= vb % self.n_workers
+        if vb == 0:
+            if self.recorder.verbose:
+                print(
+                    f"validate: n_val={self.model.data.n_val} < "
+                    f"{self.n_workers} workers, skipping",
+                    flush=True,
+                )
+            return {}
         accums: dict[str, list] = {}
-        for batch in self.model.data.val_batches(self.global_batch):
+        for batch in self.model.data.val_batches(vb):
             m = self.val_iter(batch)
             for k, v in m.items():
                 accums.setdefault(k, []).append(v)
@@ -189,6 +201,7 @@ class BSPTrainer:
             ):
                 self.train_iter(batch, lr)
             self.validate(epoch)
+            self.epoch = epoch + 1  # resume point: next epoch, not this one
         self.recorder.save()
         model.cleanup()
         return self.recorder

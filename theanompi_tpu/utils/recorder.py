@@ -139,6 +139,8 @@ class Recorder:
 
     def load(self, path: str | None = None) -> None:
         path = path or self.save_dir
+        if path is None:
+            return
         for name, hist in (
             ("time", self.time_history),
             ("train", self.train_history),
