@@ -50,12 +50,9 @@ def build_trainer(model_name: str):
 
 
 def main():
-    model_name = os.environ.get("BENCH_MODEL", "resnet50")
-    try:
-        trainer, model = build_trainer(model_name)
-    except ImportError:
-        model_name = "wide_resnet"
-        trainer, model = build_trainer(model_name)
+    # default flips to resnet50 when that model lands in the zoo
+    model_name = os.environ.get("BENCH_MODEL", "wide_resnet")
+    trainer, model = build_trainer(model_name)
     platform = jax.devices()[0].platform
     steps = int(os.environ.get("BENCH_STEPS", "30" if platform == "tpu" else "10"))
 

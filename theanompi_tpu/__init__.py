@@ -30,9 +30,7 @@ _RULES = {
     "GOSGD": "theanompi_tpu.parallel.gosgd",
 }
 
-# NOTE: only importable rules may appear here (star-import contract); EASGD
-# and GOSGD join when their modules land.
-__all__ = ["BSP", "__version__"]
+__all__ = ["BSP", "EASGD", "GOSGD", "__version__"]
 
 
 def __getattr__(name):

@@ -17,37 +17,22 @@ the reference's exchanger strategies chased by hand.
 
 from __future__ import annotations
 
-import time
-from functools import partial
-from typing import Any
-
 import jax
-import jax.numpy as jnp
-import numpy as np
-from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from theanompi_tpu.parallel.exchanger import Exchanger
-from theanompi_tpu.parallel.mesh import (
-    DATA_AXIS,
-    make_mesh,
-    replica_rng,
-    shard_map,
+from theanompi_tpu.parallel.mesh import DATA_AXIS, shard_map
+from theanompi_tpu.parallel.trainer import (
+    BaseTrainer,
+    Rule,
+    make_local_eval,
+    make_local_step,
 )
-from theanompi_tpu.utils.helper_funcs import import_model, replicate, shard_batch
+from theanompi_tpu.utils.helper_funcs import replicate
 from theanompi_tpu.utils.recorder import Recorder
 
 
-def _pmean_floats(tree, axis_name):
-    def f(x):
-        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
-            return lax.pmean(x, axis_name)
-        return x
-
-    return jax.tree.map(f, tree)
-
-
-class BSPTrainer:
+class BSPTrainer(BaseTrainer):
     """Compiles and drives the BSP step for one model on one mesh.
 
     Owns the reference worker's ``compile_iter_fns``/``train_iter``/
@@ -63,48 +48,17 @@ class BSPTrainer:
         recorder: Recorder | None = None,
         seed: int = 0,
     ):
-        self.model = model
-        self.mesh = mesh if mesh is not None else make_mesh(n_data=1)
-        self.n_workers = self.mesh.shape[DATA_AXIS]
+        super().__init__(model, mesh=mesh, recorder=recorder, seed=seed)
         self.exchanger = Exchanger(strategy=exch_strategy)
-        self.recorder = recorder or Recorder()
-        self.seed = seed
-        self.optimizer = model.build_optimizer()
-        self.global_batch = model.batch_size * self.n_workers
-        self._step_fn = None
-        self._eval_fn = None
-        self.params = None
-        self.state = None
-        self.opt_state = None
-        self.epoch = 0
-        self.iteration = 0
 
-    # -- compilation --------------------------------------------------------
+    # -- compilation ---------------------------------------------------------
     def compile_iter_fns(self) -> None:
         """Build + jit the train/eval steps (reference method name)."""
-        model, mesh, ex, opt = self.model, self.mesh, self.exchanger, self.optimizer
-        base_key = jax.random.PRNGKey(self.seed)
-
-        def local_step(params, state, opt_state, batch, lr, step):
-            rng = replica_rng(jax.random.fold_in(base_key, step), DATA_AXIS)
-
-            def lossw(p):
-                return model.loss_fn(p, state, batch, rng, train=True)
-
-            (_, (new_state, metrics)), grads = jax.value_and_grad(
-                lossw, has_aux=True
-            )(params)
-            grads = ex.exchange(grads)
-            new_params, new_opt_state = opt.update(grads, opt_state, params, lr)
-            metrics = _pmean_floats(metrics, DATA_AXIS)
-            # keep non-learned state consistent across replicas (already
-            # identical under sync-BN; pmean repairs drift otherwise)
-            new_state = _pmean_floats(new_state, DATA_AXIS)
-            return new_params, new_state, new_opt_state, metrics
-
-        def local_eval(params, state, batch):
-            _, (_, metrics) = model.loss_fn(params, state, batch, None, train=False)
-            return _pmean_floats(metrics, DATA_AXIS)
+        local_step = make_local_step(
+            self.model, self.optimizer, jax.random.PRNGKey(self.seed),
+            exchanger=self.exchanger,
+        )
+        local_eval = make_local_eval(self.model)
 
         self._step_fn = jax.jit(
             shard_map(
@@ -130,140 +84,20 @@ class BSPTrainer:
         self.state = replicate(self.mesh, state)
         self.opt_state = replicate(self.mesh, self.optimizer.init(params))
 
-    # -- iteration (reference train_iter/val_iter) --------------------------
-    def train_iter(self, batch: dict, lr: float, recorder: Recorder | None = None):
-        r = recorder or self.recorder
-        r.start("wait")
-        batch = shard_batch(self.mesh, batch)
-        r.end("wait")
-        r.start("calc")
-        self.params, self.state, self.opt_state, metrics = self._step_fn(
-            self.params,
-            self.state,
-            self.opt_state,
-            batch,
-            jnp.float32(lr),
-            jnp.int32(self.iteration),
-        )
-        self.iteration += 1
-        # fence only at print boundaries: per-iter blocking would serialize
-        # the dispatch pipeline (SURVEY.md §7 hard part 5)
-        fence = (
-            metrics["cost"]
-            if self.iteration % r.print_freq == 0
-            else None
-        )
-        r.end("calc", fence=fence)
-        r.end_iteration()
-        r.train_metrics(**metrics)
-        r.print_train_info(self.iteration)
-        return metrics
 
-    def val_iter(self, batch: dict, recorder: Recorder | None = None):
-        batch = shard_batch(self.mesh, batch)
-        return self._eval_fn(self.params, self.state, batch)
+class BSP(Rule):
+    """Synchronous data-parallel rule (see :class:`Rule` for usage)."""
 
-    def validate(self, epoch: int):
-        # the val set may be smaller than the global batch; shrink to the
-        # largest worker-divisible batch rather than silently skipping
-        vb = min(self.global_batch, self.model.data.n_val)
-        vb -= vb % self.n_workers
-        if vb == 0:
-            if self.recorder.verbose:
-                print(
-                    f"validate: n_val={self.model.data.n_val} < "
-                    f"{self.n_workers} workers, skipping",
-                    flush=True,
-                )
-            return {}
-        accums: dict[str, list] = {}
-        for batch in self.model.data.val_batches(vb):
-            m = self.val_iter(batch)
-            for k, v in m.items():
-                accums.setdefault(k, []).append(v)
-        means = {k: float(np.mean([float(x) for x in v])) for k, v in accums.items()}
-        self.recorder.val_metrics(epoch, **means)
-        return means
-
-    # -- full run (reference BSP_Worker.run) --------------------------------
-    def run(self):
-        if self._step_fn is None:
-            self.compile_iter_fns()
-        if self.params is None:
-            self.init_state()
-        model = self.model
-        for epoch in range(self.epoch, model.n_epochs):
-            self.epoch = epoch
-            self.recorder.start_epoch()
-            lr = model.adjust_hyperp(epoch)
-            for batch in model.data.train_batches(
-                self.global_batch, epoch, seed=self.seed
-            ):
-                self.train_iter(batch, lr)
-            self.validate(epoch)
-            self.epoch = epoch + 1  # resume point: next epoch, not this one
-        self.recorder.save()
-        model.cleanup()
-        return self.recorder
-
-
-class BSP:
-    """Reference-compatible rule facade.
-
-    Usage (mirrors the reference README pattern, SURVEY.md §3.1)::
-
-        rule = BSP(config={"exch_strategy": "psum"})
-        rule.init(devices=8, modelfile="theanompi_tpu.models.wide_resnet",
-                  modelclass="WideResNet")
-        rule.wait()
-
-    ``devices`` is a worker count, a list of jax devices, or None (all
-    devices).  ``init`` builds the mesh and compiles; ``wait`` runs training
-    to completion and returns the recorder (there is no process tree to join
-    — the "cluster" is the mesh).
-    """
-
-    def __init__(self, config: dict[str, Any] | None = None):
-        self.config = config or {}
-        self.trainer: BSPTrainer | None = None
-
-    def init(
-        self,
-        devices=None,
-        modelfile: str = "theanompi_tpu.models.wide_resnet",
-        modelclass: str = "WideResNet",
-        model_config: dict | None = None,
-    ) -> "BSP":
-        if isinstance(devices, int):
-            mesh = make_mesh(n_data=devices, devices=jax.devices()[:devices])
-        elif devices is None:
-            mesh = make_mesh()
-        else:
-            mesh = make_mesh(n_data=len(devices), devices=devices)
-        n = mesh.shape[DATA_AXIS]
-        model_config = dict(model_config or {})
-        if n > 1:
+    def adjust_model_config(self, model_config: dict, n_workers: int) -> None:
+        if n_workers > 1:
             # multi-worker: cross-replica BN statistics by default
             model_config.setdefault("bn_axis", DATA_AXIS)
-        model_cls = import_model(modelfile, modelclass)
-        model = model_cls(model_config)
-        self.trainer = BSPTrainer(
+
+    def make_trainer(self, model, mesh, recorder) -> BSPTrainer:
+        return BSPTrainer(
             model,
             mesh=mesh,
             exch_strategy=self.config.get("exch_strategy", "psum"),
-            recorder=Recorder(
-                print_freq=self.config.get("print_freq", 40),
-                save_dir=self.config.get("record_dir"),
-                verbose=self.config.get("verbose", model.verbose),
-            ),
+            recorder=recorder,
             seed=self.config.get("seed", 0),
         )
-        self.trainer.compile_iter_fns()
-        self.trainer.init_state()
-        return self
-
-    def wait(self):
-        """Run training to completion (reference: join the mpirun tree)."""
-        if self.trainer is None:
-            raise RuntimeError("call init() before wait()")
-        return self.trainer.run()

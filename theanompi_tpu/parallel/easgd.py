@@ -1,0 +1,154 @@
+"""EASGD: elastic-averaging data parallelism (reference's async rule).
+
+Reference (unverified — SURVEY.md §2.1/§3.3): ``easgd_server.py`` holds center
+parameters on its own GPU and services async worker requests; each
+``easgd_worker.py`` runs τ local SGD steps then elastically averages with the
+center (worker: ``p += α(center − p)``; server: ``center += α(p − center)``),
+with LR scaled by worker count (``model.scale_lr``).
+
+TPU-native re-expression: XLA is bulk-synchronous — there is no async
+one-sided communication — so the rule becomes its *synchronous periodic*
+variant (the EASGD paper's sync form, which the τ-periodic reference already
+approximates): every worker keeps its own divergent parameter copy (stacked
+along a leading axis sharded over ``data``), runs τ collective-free local
+steps, then one collective elastic exchange updates workers and center
+together::
+
+    diff_i  = p_i − center
+    p_i    ← p_i − α·diff_i
+    center ← center + α·Σ_i diff_i
+
+No server chip is sacrificed (the reference dedicated a GPU to the center);
+the center is replicated and updated by the same psum that reads the workers.
+Semantics preserved: bounded staleness τ, elastic moving rate α, divergent
+exploration between exchanges.  Semantics changed: exchanges are mutually
+synchronous rounds rather than per-worker-clock asynchronous events.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from theanompi_tpu.parallel.mesh import DATA_AXIS, shard_map
+from theanompi_tpu.parallel.trainer import (
+    BaseTrainer,
+    Rule,
+    make_local_eval,
+    make_local_step,
+    pmean_floats,
+    restack,
+    stack_for_workers,
+    unstack,
+)
+from theanompi_tpu.utils.helper_funcs import replicate
+from theanompi_tpu.utils.recorder import Recorder
+
+
+def elastic_exchange(params, center, alpha, axis_name=DATA_AXIS):
+    """One synchronous elastic-averaging round (pure, inside shard_map)."""
+
+    def is_float(x):
+        return jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+
+    new_p = jax.tree.map(
+        lambda p, c: p - alpha * (p - c) if is_float(p) else p, params, center
+    )
+    new_c = jax.tree.map(
+        lambda p, c: c + alpha * lax.psum(p - c, axis_name) if is_float(p) else c,
+        params,
+        center,
+    )
+    return new_p, new_c
+
+
+class EASGDTrainer(BaseTrainer):
+    """τ local steps per worker, then a collective elastic exchange.
+
+    ``alpha`` defaults to the EASGD paper's stable choice ``0.9/(τ·n)``
+    scaled rule of thumb — here simply ``0.5/n`` matching the reference's
+    default moving rate divided across the synchronous round.
+    """
+
+    def __init__(self, model, mesh=None, recorder: Recorder | None = None,
+                 seed: int = 0, tau: int = 4, alpha: float | None = None):
+        super().__init__(model, mesh=mesh, recorder=recorder, seed=seed)
+        self.tau = tau
+        self.alpha = alpha if alpha is not None else 0.5 / self.n_workers
+        self.center = None
+        self._exchange_fn = None
+        self._consensus_state_fn = None
+
+    def compile_iter_fns(self) -> None:
+        local_step = make_local_step(
+            self.model, self.optimizer, jax.random.PRNGKey(self.seed),
+            stacked=True,
+        )
+        local_eval = make_local_eval(self.model)
+
+        def exchange(params, center):
+            new_p, new_c = elastic_exchange(unstack(params), center, self.alpha)
+            return restack(new_p), new_c
+
+        def consensus_state(state):
+            return pmean_floats(unstack(state), DATA_AXIS)
+
+        W = P(DATA_AXIS)
+        self._step_fn = jax.jit(
+            shard_map(
+                local_step,
+                self.mesh,
+                in_specs=(W, W, W, W, P(), P()),
+                out_specs=(W, W, W, W),
+            ),
+            donate_argnums=(0, 1, 2),
+        )
+        self._exchange_fn = jax.jit(
+            shard_map(exchange, self.mesh, in_specs=(W, P()), out_specs=(W, P())),
+            donate_argnums=(0, 1),
+        )
+        self._eval_fn = jax.jit(
+            shard_map(
+                local_eval, self.mesh, in_specs=(P(), P(), W), out_specs=P()
+            )
+        )
+        self._consensus_state_fn = jax.jit(
+            shard_map(consensus_state, self.mesh, in_specs=(W,), out_specs=P())
+        )
+
+    def init_state(self) -> None:
+        params, state = self.model.init_params(jax.random.PRNGKey(self.seed + 1))
+        n = self.n_workers
+        self.params = stack_for_workers(self.mesh, params, n)
+        self.state = stack_for_workers(self.mesh, state, n)
+        self.opt_state = stack_for_workers(self.mesh, self.optimizer.init(params), n)
+        self.center = replicate(self.mesh, params)
+
+    def post_step(self) -> None:
+        if self.iteration % self.tau == 0:
+            self.recorder.start("comm")
+            self.params, self.center = self._exchange_fn(self.params, self.center)
+            self.recorder.end("comm")
+
+    def eval_args(self):
+        """Validate with the center parameters (the reference server's job)."""
+        return self.center, self._consensus_state_fn(self.state)
+
+
+class EASGD(Rule):
+    """Elastic-averaging rule.  Config: ``tau``, ``alpha``, ``scale_lr``."""
+
+    def make_trainer(self, model, mesh, recorder) -> EASGDTrainer:
+        n = mesh.shape[DATA_AXIS]
+        if n > 1 and self.config.get("scale_lr", True):
+            model.scale_lr(n)  # reference EASGD worker hook
+        return EASGDTrainer(
+            model,
+            mesh=mesh,
+            recorder=recorder,
+            seed=self.config.get("seed", 0),
+            tau=self.config.get("tau", 4),
+            alpha=self.config.get("alpha"),
+        )

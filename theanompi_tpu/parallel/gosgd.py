@@ -1,0 +1,196 @@
+"""GOSGD: gossip data parallelism (reference's peer-to-peer async rule).
+
+Reference (unverified — SURVEY.md §2.1/§3.4): ``gosgd_worker.py`` — after each
+local step every worker draws Bernoulli(p); on success it sends half its
+consensus weight ``w_i`` plus its parameters to a uniformly random peer, which
+merges ``p_j ← (w_j·p_j + w_i/2·p_i)/(w_j + w_i/2)`` (Blot et al. 2016,
+"Gossip training for deep learning").
+
+TPU-native re-expression: the Bernoulli push draws and a random ring shift
+``k ∈ {1..n-1}`` are sampled **on host** each round, then one compiled
+collective round applies every push at once: pusher ``i``'s target is
+``(i+k) mod n`` — marginally uniform over its peers, identical to the
+reference's per-worker marginal — and the routing is ``k`` repetitions of the
+single-hop ring ``ppermute`` (a ``fori_loop`` with a traced trip count), so a
+round costs at most ``n-1`` ICI hops and needs no data-dependent permutation.
+Weight conservation (Σw = 1) holds by construction.  Semantics changed:
+pushes land at round boundaries instead of asynchronously mid-step, and
+within one round targets are a cyclic shift (no collisions) rather than
+jointly-iid — the per-worker target distribution is unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from theanompi_tpu.parallel.mesh import DATA_AXIS, shard_map
+from theanompi_tpu.parallel.trainer import (
+    BaseTrainer,
+    Rule,
+    make_local_eval,
+    make_local_step,
+    pmean_floats,
+    restack,
+    stack_for_workers,
+    unstack,
+)
+from theanompi_tpu.utils.recorder import Recorder
+
+
+def gossip_merge(params, weight, push, shift, n, axis_name=DATA_AXIS):
+    """One gossip round for this worker (pure, inside shard_map).
+
+    ``params``: this worker's pytree; ``weight``: scalar consensus weight;
+    ``push``: replicated 0/1 vector ``[n]`` of who pushes; ``shift``: traced
+    ring shift — pusher ``i`` targets ``(i+shift) mod n``.  Returns merged
+    (params, weight).
+    """
+    me = lax.axis_index(axis_name)
+    my_push = push[me]
+    sent_w = my_push * weight * 0.5
+    kept_w = weight - sent_w
+
+    is_float = lambda x: jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+    outgoing = [sent_w] + [
+        sent_w * leaf.astype(jnp.float32)
+        for leaf in jax.tree.leaves(params)
+        if is_float(leaf)
+    ]
+    ring = [(i, (i + 1) % n) for i in range(n)]
+
+    def hop(_, carry):
+        return [lax.ppermute(x, axis_name, ring) for x in carry]
+
+    # shift hops of the one-step ring == ppermute by the random shift; the
+    # trip count is traced, so one compiled program serves every draw
+    incoming = lax.fori_loop(0, shift, hop, outgoing)
+    recv_w, recv_leaves = incoming[0], incoming[1:]
+    new_w = kept_w + recv_w  # > 0 always: kept_w >= weight/2 > 0
+
+    recv_iter = iter(recv_leaves)
+
+    def merge(leaf):
+        if not is_float(leaf):
+            return leaf
+        merged = (kept_w * leaf.astype(jnp.float32) + next(recv_iter)) / new_w
+        return merged.astype(leaf.dtype)
+
+    return jax.tree.map(merge, params), new_w
+
+
+class GOSGDTrainer(BaseTrainer):
+    """Local SGD + host-drawn randomized gossip rounds.
+
+    ``p_push`` is the per-iteration Bernoulli probability (reference default
+    semantics; 1/n keeps expected traffic at one push per round).
+    """
+
+    def __init__(self, model, mesh=None, recorder: Recorder | None = None,
+                 seed: int = 0, p_push: float | None = None):
+        super().__init__(model, mesh=mesh, recorder=recorder, seed=seed)
+        self.p_push = p_push if p_push is not None else 1.0 / max(self.n_workers, 2)
+        self.weights = None
+        self._gossip_fn = None
+        self._consensus_fn = None
+        self._host_rng = np.random.RandomState(seed + 17)
+
+    def compile_iter_fns(self) -> None:
+        local_step = make_local_step(
+            self.model, self.optimizer, jax.random.PRNGKey(self.seed),
+            stacked=True,
+        )
+        local_eval = make_local_eval(self.model)
+        n = self.n_workers
+
+        def gossip(params, weight, push, shift):
+            new_p, new_w = gossip_merge(
+                unstack(params), unstack(weight), push, shift, n
+            )
+            return restack(new_p), new_w[None]
+
+        def consensus(params, weight, state):
+            params, state = unstack(params), unstack(state)
+            w = unstack(weight)
+
+            def avg(leaf):
+                if not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+                    return leaf
+                return lax.psum(w * leaf.astype(jnp.float32), DATA_AXIS).astype(
+                    leaf.dtype
+                )
+
+            return jax.tree.map(avg, params), pmean_floats(state, DATA_AXIS)
+
+        W = P(DATA_AXIS)
+        self._step_fn = jax.jit(
+            shard_map(
+                local_step,
+                self.mesh,
+                in_specs=(W, W, W, W, P(), P()),
+                out_specs=(W, W, W, W),
+            ),
+            donate_argnums=(0, 1, 2),
+        )
+        self._gossip_fn = jax.jit(
+            shard_map(
+                gossip, self.mesh, in_specs=(W, W, P(), P()), out_specs=(W, W)
+            ),
+            donate_argnums=(0, 1),
+        )
+        self._eval_fn = jax.jit(
+            shard_map(local_eval, self.mesh, in_specs=(P(), P(), W), out_specs=P())
+        )
+        self._consensus_fn = jax.jit(
+            shard_map(
+                consensus, self.mesh, in_specs=(W, W, W), out_specs=(P(), P())
+            )
+        )
+
+    def init_state(self) -> None:
+        params, state = self.model.init_params(jax.random.PRNGKey(self.seed + 1))
+        n = self.n_workers
+        self.params = stack_for_workers(self.mesh, params, n)
+        self.state = stack_for_workers(self.mesh, state, n)
+        self.opt_state = stack_for_workers(self.mesh, self.optimizer.init(params), n)
+        self.weights = jax.device_put(
+            np.full((n,), 1.0 / n, np.float32), NamedSharding(self.mesh, P(DATA_AXIS))
+        )
+
+    def post_step(self) -> None:
+        n = self.n_workers
+        if n == 1:
+            return
+        push = (self._host_rng.rand(n) < self.p_push).astype(np.float32)
+        if not push.any():
+            return  # no sender drawn this round — skip the collective
+        # random ring shift: every pusher's target is uniform over its peers
+        shift = self._host_rng.randint(1, n)
+        self.recorder.start("comm")
+        self.params, self.weights = self._gossip_fn(
+            self.params,
+            self.weights,
+            jnp.asarray(push),
+            jnp.int32(shift),
+        )
+        self.recorder.end("comm")
+
+    def eval_args(self):
+        """Validate with the weighted consensus of all workers."""
+        return self._consensus_fn(self.params, self.weights, self.state)
+
+
+class GOSGD(Rule):
+    """Gossip rule.  Config: ``p_push``."""
+
+    def make_trainer(self, model, mesh, recorder) -> GOSGDTrainer:
+        return GOSGDTrainer(
+            model,
+            mesh=mesh,
+            recorder=recorder,
+            seed=self.config.get("seed", 0),
+            p_push=self.config.get("p_push"),
+        )

@@ -1,0 +1,295 @@
+"""Shared trainer/rule scaffolding for the three parallel rules.
+
+Reference (unverified — SURVEY.md §2.1): the per-rule worker scripts
+(``bsp_worker.py``, ``easgd_worker.py``/``easgd_server.py``,
+``gosgd_worker.py``) share their epoch/validation/recording skeleton and
+differ in how parameters are exchanged.  Here the skeleton is
+:class:`BaseTrainer` (compile → iterate → validate → record) and each rule
+supplies the compiled step + parameter layout:
+
+- BSP: one replicated parameter set, exchange fused into the step;
+- EASGD/GOSGD: *per-worker divergent* parameter sets, stored stacked along a
+  leading axis sharded over the ``data`` mesh axis, with periodic host-driven
+  exchange steps (the SPMD reformulation of the reference's async MPI
+  messages — see each module's docstring).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from theanompi_tpu.parallel.mesh import DATA_AXIS, make_mesh, replica_rng
+from theanompi_tpu.utils.helper_funcs import import_model, shard_batch
+from theanompi_tpu.utils.recorder import Recorder
+
+
+def pmean_floats(tree, axis_name):
+    """pmean every inexact leaf; pass ints (counters etc.) through."""
+
+    def f(x):
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+            return jax.lax.pmean(x, axis_name)
+        return x
+
+    return jax.tree.map(f, tree)
+
+
+def unstack(tree):
+    """Drop the per-shard leading worker axis of size 1 (inside shard_map)."""
+    return jax.tree.map(lambda x: x[0], tree)
+
+
+def restack(tree):
+    return jax.tree.map(lambda x: x[None], tree)
+
+
+def make_local_step(model, opt, base_key, exchanger=None, stacked=False):
+    """The per-worker train step shared by every rule.
+
+    ``exchanger`` set (BSP): gradients are mean-reduced across the data axis
+    before the update, and metrics/state are pmean'd so the outputs are
+    replicated.  ``stacked`` (EASGD/GOSGD): parameter trees carry a leading
+    worker axis of size 1 per shard, the step is collective-free, and metrics
+    come back per-worker (stacked) — averaging happens on host at print time.
+    """
+
+    def local_step(params, state, opt_state, batch, lr, step):
+        if stacked:
+            params, state, opt_state = (
+                unstack(params), unstack(state), unstack(opt_state)
+            )
+        rng = replica_rng(jax.random.fold_in(base_key, step), DATA_AXIS)
+
+        def lossw(p):
+            return model.loss_fn(p, state, batch, rng, train=True)
+
+        (_, (new_state, metrics)), grads = jax.value_and_grad(
+            lossw, has_aux=True
+        )(params)
+        if exchanger is not None:
+            grads = exchanger.exchange(grads)
+        new_params, new_opt_state = opt.update(grads, opt_state, params, lr)
+        if stacked:
+            return (
+                restack(new_params),
+                restack(new_state),
+                restack(new_opt_state),
+                jax.tree.map(lambda m: m[None], metrics),
+            )
+        metrics = pmean_floats(metrics, DATA_AXIS)
+        # keep non-learned state consistent across replicas (already
+        # identical under sync-BN; pmean repairs drift otherwise)
+        new_state = pmean_floats(new_state, DATA_AXIS)
+        return new_params, new_state, new_opt_state, metrics
+
+    return local_step
+
+
+def make_local_eval(model):
+    """Shared eval step: replicated params, data-sharded batch."""
+
+    def local_eval(params, state, batch):
+        _, (_, metrics) = model.loss_fn(params, state, batch, None, train=False)
+        return pmean_floats(metrics, DATA_AXIS)
+
+    return local_eval
+
+
+def stack_for_workers(mesh, tree, n: int):
+    """Tile a pytree with a leading worker axis sharded over ``data``.
+
+    The device layout of "every worker has its own copy" — each leaf becomes
+    ``(n, *shape)`` with shard ``i`` resident on worker ``i``'s devices.
+    """
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+    def tile(x):
+        x = np.asarray(x)
+        return jax.device_put(np.broadcast_to(x, (n, *x.shape)).copy(), sharding)
+
+    return jax.tree.map(tile, tree)
+
+
+class BaseTrainer:
+    """Compile-and-iterate skeleton; subclasses define the step + layout.
+
+    Subclass obligations: ``compile_iter_fns`` (set ``_step_fn``/``_eval_fn``),
+    ``init_state``, ``eval_args()`` -> (params, state) for validation, and
+    optionally ``post_step()`` (periodic exchange hook, called after every
+    train iteration with ``self.iteration`` already advanced).
+    """
+
+    def __init__(self, model, mesh=None, recorder: Recorder | None = None,
+                 seed: int = 0):
+        self.model = model
+        self.mesh = mesh if mesh is not None else make_mesh(n_data=1)
+        self.n_workers = self.mesh.shape[DATA_AXIS]
+        self.recorder = recorder or Recorder()
+        self.seed = seed
+        self.optimizer = model.build_optimizer()
+        self.global_batch = model.batch_size * self.n_workers
+        self._step_fn = None
+        self._eval_fn = None
+        self.params = None
+        self.state = None
+        self.opt_state = None
+        self.epoch = 0
+        self.iteration = 0
+
+    # -- subclass surface ----------------------------------------------------
+    def compile_iter_fns(self) -> None:
+        raise NotImplementedError
+
+    def init_state(self) -> None:
+        raise NotImplementedError
+
+    def eval_args(self):
+        """-> (params, state) to evaluate with (replicated)."""
+        return self.params, self.state
+
+    def post_step(self) -> None:
+        """Periodic host-driven exchange hook (EASGD/GOSGD)."""
+
+    # -- iteration (reference train_iter/val_iter) ---------------------------
+    def train_iter(self, batch: dict, lr: float, recorder: Recorder | None = None):
+        r = recorder or self.recorder
+        r.start("wait")
+        batch = shard_batch(self.mesh, batch)
+        r.end("wait")
+        r.start("calc")
+        self.params, self.state, self.opt_state, metrics = self._step_fn(
+            self.params,
+            self.state,
+            self.opt_state,
+            batch,
+            jnp.float32(lr),
+            jnp.int32(self.iteration),
+        )
+        self.iteration += 1
+        # fence only at print boundaries: per-iter blocking would serialize
+        # the dispatch pipeline (SURVEY.md §7 hard part 5)
+        fence = metrics["cost"] if self.iteration % r.print_freq == 0 else None
+        r.end("calc", fence=fence)
+        self.post_step()
+        r.end_iteration()
+        r.train_metrics(**metrics)
+        r.print_train_info(self.iteration)
+        return metrics
+
+    def val_iter(self, batch: dict, recorder: Recorder | None = None,
+                 eval_args=None):
+        batch = shard_batch(self.mesh, batch)
+        # eval_args may be expensive (GOSGD consensus psums the whole param
+        # tree) — validate() hoists it out of the per-batch loop
+        params, state = eval_args if eval_args is not None else self.eval_args()
+        return self._eval_fn(params, state, batch)
+
+    def validate(self, epoch: int):
+        # the val set may be smaller than the global batch; shrink to the
+        # largest worker-divisible batch rather than silently skipping
+        vb = min(self.global_batch, self.model.data.n_val)
+        vb -= vb % self.n_workers
+        if vb == 0:
+            if self.recorder.verbose:
+                print(
+                    f"validate: n_val={self.model.data.n_val} < "
+                    f"{self.n_workers} workers, skipping",
+                    flush=True,
+                )
+            return {}
+        accums: dict[str, list] = {}
+        eval_args = self.eval_args()
+        for batch in self.model.data.val_batches(vb):
+            m = self.val_iter(batch, eval_args=eval_args)
+            for k, v in m.items():
+                accums.setdefault(k, []).append(v)
+        means = {k: float(np.mean([float(x) for x in v])) for k, v in accums.items()}
+        self.recorder.val_metrics(epoch, **means)
+        return means
+
+    # -- full run (reference *_worker.run) -----------------------------------
+    def run(self):
+        if self._step_fn is None:
+            self.compile_iter_fns()
+        if self.params is None:
+            self.init_state()
+        model = self.model
+        for epoch in range(self.epoch, model.n_epochs):
+            self.epoch = epoch
+            self.recorder.start_epoch()
+            lr = model.adjust_hyperp(epoch)
+            for batch in model.data.train_batches(
+                self.global_batch, epoch, seed=self.seed
+            ):
+                self.train_iter(batch, lr)
+            self.validate(epoch)
+            self.epoch = epoch + 1  # resume point: next epoch, not this one
+        self.recorder.save()
+        model.cleanup()
+        return self.recorder
+
+
+class Rule:
+    """Reference-compatible rule facade shared by BSP/EASGD/GOSGD.
+
+    Usage (mirrors the reference README pattern, SURVEY.md §3.1)::
+
+        rule = BSP(config={"exch_strategy": "psum"})
+        rule.init(devices=8, modelfile="theanompi_tpu.models.wide_resnet",
+                  modelclass="WideResNet")
+        rule.wait()
+
+    ``devices`` is a worker count, a list of jax devices, or None (all
+    devices).  ``init`` builds the mesh and compiles; ``wait`` runs training
+    to completion and returns the recorder (there is no process tree to join
+    — the "cluster" is the mesh).
+    """
+
+    def __init__(self, config: dict[str, Any] | None = None):
+        self.config = config or {}
+        self.trainer: BaseTrainer | None = None
+
+    def make_trainer(self, model, mesh, recorder) -> BaseTrainer:
+        raise NotImplementedError
+
+    def adjust_model_config(self, model_config: dict, n_workers: int) -> None:
+        """Rule-specific model-config defaults (e.g. sync-BN for BSP)."""
+
+    def init(
+        self,
+        devices=None,
+        modelfile: str = "theanompi_tpu.models.wide_resnet",
+        modelclass: str = "WideResNet",
+        model_config: dict | None = None,
+    ):
+        if isinstance(devices, int):
+            mesh = make_mesh(n_data=devices, devices=jax.devices()[:devices])
+        elif devices is None:
+            mesh = make_mesh()
+        else:
+            mesh = make_mesh(n_data=len(devices), devices=devices)
+        n = mesh.shape[DATA_AXIS]
+        model_config = dict(model_config or {})
+        self.adjust_model_config(model_config, n)
+        model_cls = import_model(modelfile, modelclass)
+        model = model_cls(model_config)
+        recorder = Recorder(
+            print_freq=self.config.get("print_freq", 40),
+            save_dir=self.config.get("record_dir"),
+            verbose=self.config.get("verbose", model.verbose),
+        )
+        self.trainer = self.make_trainer(model, mesh, recorder)
+        self.trainer.compile_iter_fns()
+        self.trainer.init_state()
+        return self
+
+    def wait(self):
+        """Run training to completion (reference: join the mpirun tree)."""
+        if self.trainer is None:
+            raise RuntimeError("call init() before wait()")
+        return self.trainer.run()

@@ -72,8 +72,10 @@ class Recorder:
         """Every ``print_freq`` iterations: averaged metrics + time split."""
         if count % self.print_freq != 0 or not self._train_accum:
             return
+        # np.asarray(...).mean(): metrics may be per-worker vectors (the
+        # async rules report without a cross-worker collective in the step)
         means = {
-            k: float(np.mean([float(x) for x in v]))
+            k: float(np.mean([np.asarray(x).mean() for x in v]))
             for k, v in self._train_accum.items()
         }
         for k, v in means.items():
