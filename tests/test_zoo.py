@@ -1,0 +1,165 @@
+"""Model zoo tests: every model initializes and takes one finite BSP step.
+
+The reference validated models by full training curves (SURVEY.md §4 —
+convergence-as-test); the fast equivalents here assert init shapes, one
+train step with finite loss, and (slow-marked) short-loop learning.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from theanompi_tpu.parallel.bsp import BSPTrainer
+from theanompi_tpu.parallel.mesh import make_mesh
+from theanompi_tpu.utils.helper_funcs import tree_count
+
+# (modelfile, modelclass, tiny-config, expected logits trailing dim)
+ZOO = [
+    ("theanompi_tpu.models.alex_net", "AlexNet",
+     {"image_size": 64, "n_classes": 11, "lrn": True}, 11),
+    ("theanompi_tpu.models.vggnet_16", "VGGNet_16",
+     {"image_size": 32, "n_classes": 7, "fc_width": 64}, 7),
+    ("theanompi_tpu.models.vggnet_16", "VGGNet_11_Shallow",
+     {"image_size": 32, "n_classes": 7, "fc_width": 64}, 7),
+    ("theanompi_tpu.models.resnet50", "ResNet50",
+     {"image_size": 32, "n_classes": 9, "stage_blocks": (1, 1, 1, 1)}, 9),
+    ("theanompi_tpu.models.googlenet", "GoogLeNet",
+     {"image_size": 64, "n_classes": 13, "lrn": True}, 13),
+]
+
+COMMON = {"batch_size": 4, "n_train": 32, "n_val": 16, "shard_size": 16,
+          "n_epochs": 1, "precision": "fp32"}
+
+
+def _load(modelfile, modelclass, cfg):
+    import importlib
+
+    cls = getattr(importlib.import_module(modelfile), modelclass)
+    return cls({**COMMON, **cfg})
+
+
+@pytest.mark.parametrize("modelfile,modelclass,cfg,n_out", ZOO)
+def test_model_one_step(modelfile, modelclass, cfg, n_out):
+    model = _load(modelfile, modelclass, cfg)
+    t = BSPTrainer(model, mesh=make_mesh(n_data=1, devices=jax.devices()[:1]))
+    t.compile_iter_fns()
+    t.init_state()
+    assert tree_count(t.params) > 0
+    batch = next(iter(model.data.train_batches(t.global_batch, 0, seed=0)))
+    m = t.train_iter(batch, lr=0.01)
+    assert np.isfinite(float(m["cost"])), f"{modelclass}: non-finite loss"
+    v = t.validate(0)
+    assert np.isfinite(v["cost"])
+
+
+def test_resnet50_full_depth_param_count():
+    """Real ResNet-50 (3,4,6,3) should land near the canonical 25.6M params."""
+    from theanompi_tpu.models.resnet50 import ResNet50
+
+    model = ResNet50({**COMMON, "image_size": 64, "n_classes": 1000})
+    params, _ = model.init_params(jax.random.PRNGKey(0))
+    n = tree_count(params)
+    assert 24e6 < n < 27e6, f"ResNet-50 param count off: {n/1e6:.1f}M"
+
+
+def test_alexnet_param_count():
+    """AlexNet at 224/1000 has ~60-62M params (canonical)."""
+    from theanompi_tpu.models.alex_net import AlexNet
+
+    model = AlexNet({**COMMON, "image_size": 224, "n_classes": 1000})
+    params, _ = model.init_params(jax.random.PRNGKey(0))
+    n = tree_count(params)
+    assert 55e6 < n < 65e6, f"AlexNet param count off: {n/1e6:.1f}M"
+
+
+def test_lstm_one_step_and_perplexity():
+    from theanompi_tpu.models.lstm import LSTM
+
+    model = LSTM({"batch_size": 8, "n_train": 64, "n_val": 32, "seq_len": 12,
+                  "vocab": 50, "hidden": 32, "embed_dim": 32, "n_layers": 2,
+                  "n_epochs": 1, "precision": "fp32", "dropout": 0.1})
+    t = BSPTrainer(model, mesh=make_mesh(n_data=1, devices=jax.devices()[:1]))
+    t.compile_iter_fns()
+    t.init_state()
+    batch = next(iter(model.data.train_batches(t.global_batch, 0, seed=0)))
+    m = t.train_iter(batch, lr=0.5)
+    assert np.isfinite(float(m["cost"]))
+    # perplexity metric present and consistent with cost
+    np.testing.assert_allclose(
+        float(m["perplexity"]), np.exp(float(m["cost"])), rtol=1e-3
+    )
+
+
+@pytest.mark.slow
+def test_lstm_learns_bigram_structure():
+    from theanompi_tpu.models.lstm import LSTM
+    from theanompi_tpu.parallel.trainer import BaseTrainer  # noqa: F401
+
+    model = LSTM({"batch_size": 16, "n_train": 256, "n_val": 64, "seq_len": 16,
+                  "vocab": 32, "hidden": 64, "embed_dim": 32, "n_layers": 1,
+                  "n_epochs": 4, "precision": "fp32", "dropout": 0.0,
+                  "lr": 0.5, "momentum": 0.9})
+    t = BSPTrainer(model, mesh=make_mesh(n_data=1, devices=jax.devices()[:1]))
+    rec = t.run()
+    ppl = rec.val_history["perplexity"]
+    assert ppl[-1] < 32, f"perplexity should beat uniform(32): {ppl}"
+    assert ppl[-1] < ppl[0]
+
+
+def test_dcgan_one_step():
+    from theanompi_tpu.models.dcgan import DCGAN
+
+    model = DCGAN({"batch_size": 8, "n_train": 64, "n_val": 32,
+                   "image_size": 32, "gen_base": 32, "disc_base": 16,
+                   "z_dim": 16, "n_epochs": 1, "precision": "fp32"})
+    t = BSPTrainer(model, mesh=make_mesh(n_data=1, devices=jax.devices()[:1]))
+    t.compile_iter_fns()
+    t.init_state()
+    before = jax.tree.map(np.array, t.params)
+    batch = next(iter(model.data.train_batches(t.global_batch, 0, seed=0)))
+    m = t.train_iter(batch, lr=2e-4)
+    for k in ("cost", "d_loss", "g_loss"):
+        assert np.isfinite(float(m[k])), f"{k} not finite"
+    # both nets' params actually moved
+    for net in ("gen", "disc"):
+        moved = any(
+            not np.allclose(np.asarray(a), b)
+            for a, b in zip(
+                jax.tree.leaves(t.params[net]), jax.tree.leaves(before[net])
+            )
+        )
+        assert moved, f"{net} params did not move"
+
+
+def test_dcgan_bsp_multiworker(mesh8):
+    from theanompi_tpu.models.dcgan import DCGAN
+
+    model = DCGAN({"batch_size": 2, "n_train": 64, "n_val": 32,
+                   "image_size": 32, "gen_base": 32, "disc_base": 16,
+                   "z_dim": 16, "n_epochs": 1, "precision": "fp32"})
+    t = BSPTrainer(model, mesh=mesh8)
+    t.compile_iter_fns()
+    t.init_state()
+    batch = next(iter(model.data.train_batches(t.global_batch, 0, seed=0)))
+    m = t.train_iter(batch, lr=2e-4)
+    assert np.isfinite(float(m["cost"]))
+
+
+def test_wgan_critic_clipped():
+    from theanompi_tpu.models.dcgan import WGAN
+
+    model = WGAN({"batch_size": 8, "n_train": 64, "n_val": 32,
+                  "image_size": 32, "gen_base": 32, "disc_base": 16,
+                  "z_dim": 16, "n_epochs": 1, "precision": "fp32",
+                  "clip": 0.01, "n_critic": 2})
+    t = BSPTrainer(model, mesh=make_mesh(n_data=1, devices=jax.devices()[:1]))
+    t.compile_iter_fns()
+    t.init_state()
+    for i, batch in enumerate(model.data.train_batches(t.global_batch, 0, seed=0)):
+        t.train_iter(batch, lr=5e-5)
+        if i >= 2:
+            break
+    for leaf in jax.tree.leaves(t.params["disc"]):
+        a = np.asarray(leaf)
+        assert (np.abs(a) <= 0.01 + 1e-6).all(), "critic weights not clipped"

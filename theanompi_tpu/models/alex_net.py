@@ -1,0 +1,70 @@
+"""AlexNet (BASELINE.md config 2 — the 8-worker BSP scaling model).
+
+Reference (unverified — SURVEY.md §2.1): ``theanompi/models/alex_net.py``,
+descended from the Ding et al. ``theano_alexnet`` 1-GPU port: 5 conv layers
+(LRN after conv1/conv2), 3 max-pools, two dropout FC-4096 layers, softmax
+over 1000 classes; trained with momentum SGD, step LR decay, and the
+paper-era crop+mirror augmentation (supplied here by
+:mod:`theanompi_tpu.models.data.imagenet`).
+
+Config ``lrn=False`` drops the LRN layers (they predate BN and cost HBM
+bandwidth; off they let XLA fuse conv+relu+pool cleanly) — default on, for
+parity with the reference.
+"""
+
+from __future__ import annotations
+
+from theanompi_tpu.models.contract import SupervisedModel
+from theanompi_tpu.models.data.imagenet import ImageNetData
+from theanompi_tpu.ops import initializers as init_lib
+from theanompi_tpu.ops import layers as L
+
+
+class AlexNet(SupervisedModel):
+    default_config = {
+        "batch_size": 128,
+        "n_epochs": 70,
+        "lr": 0.01,
+        "lr_decay_epochs": (20, 40, 60),
+        "lr_decay_factor": 0.1,
+        "momentum": 0.9,
+        "weight_decay": 5e-4,
+        "image_size": 224,
+        "n_classes": 1000,
+        "lrn": True,
+        "dropout": 0.5,
+    }
+
+    def build_data(self):
+        return ImageNetData(self.config)
+
+    def build_net(self):
+        cfg = self.config
+        maybe_lrn = [L.LRN(size=5)] if cfg["lrn"] else []
+        layers: list[L.Layer] = [
+            L.Conv2D(96, 11, stride=4, padding=2),
+            L.Activation("relu"),
+            *maybe_lrn,
+            L.MaxPool(3, stride=2),
+            L.Conv2D(256, 5, padding=2, groups=1),
+            L.Activation("relu"),
+            *maybe_lrn,
+            L.MaxPool(3, stride=2),
+            L.Conv2D(384, 3, padding=1),
+            L.Activation("relu"),
+            L.Conv2D(384, 3, padding=1),
+            L.Activation("relu"),
+            L.Conv2D(256, 3, padding=1),
+            L.Activation("relu"),
+            L.MaxPool(3, stride=2),
+            L.Flatten(),
+            L.Dense(4096),
+            L.Activation("relu"),
+            L.Dropout(cfg["dropout"]),
+            L.Dense(4096),
+            L.Activation("relu"),
+            L.Dropout(cfg["dropout"]),
+            L.Dense(cfg["n_classes"], w_init=init_lib.glorot_normal),
+        ]
+        s = cfg["image_size"]
+        return L.Sequential(layers), (s, s, 3)

@@ -59,7 +59,12 @@ class Model:
             momentum=self.config.get("momentum", 0.9),
             weight_decay=self.config.get("weight_decay", 0.0),
             nesterov=self.config.get("nesterov", False),
+            grad_clip=self.config.get("grad_clip"),
         )
+
+    def init_opt_state(self, optimizer, params):
+        """Optimizer-state layout; GANs override to split per network."""
+        return optimizer.init(params)
 
     # -- pure functions the trainer compiles --------------------------------
     def init_params(self, rng):

@@ -68,8 +68,13 @@ class Cifar10Data(ArrayDataset):
             xt = 0.5 + 0.1 * xt
             xv = 0.5 + 0.1 * xv
             self.synthetic = True
-        xt = (xt - MEAN) / STD
-        xv = (xv - MEAN) / STD
+        if config.get("normalize", "standard") == "tanh":
+            # GAN mode: [-1, 1] to match a tanh generator's output support
+            xt = xt * 2.0 - 1.0
+            xv = xv * 2.0 - 1.0
+        else:
+            xt = (xt - MEAN) / STD
+            xv = (xv - MEAN) / STD
         augment = pad_crop_mirror if config.get("augment", True) else None
         super().__init__(
             xt.astype(np.float32), yt, xv.astype(np.float32), yv,

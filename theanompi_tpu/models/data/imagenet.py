@@ -1,0 +1,232 @@
+"""ImageNet-style sharded dataset.
+
+Reference (unverified — SURVEY.md §2.1): ``theanompi/models/data/imagenet.py``
+— preprocessed hickle ``.hkl`` batch files plus label ``.npy``s; per-epoch
+shuffling of the shard file list; mean subtraction; random crop + mirror
+augmentation; worker-sharded iteration; ``para_load`` overlap (here supplied
+by :mod:`theanompi_tpu.models.data.prefetch`).
+
+On-disk layout expected under ``data_path`` (or ``$IMAGENET_PATH``)::
+
+    train/x_0000.npy  uint8 [N, S, S, 3]   (S = store_size, e.g. 256)
+    train/y_0000.npy  int32 [N]
+    val/x_0000.npy ...
+
+``.hkl`` inputs from a reference-era preprocessing run can be converted with
+:func:`convert_hkl_tree` (requires ``hickle``, which is optional).  In this
+zero-egress image a deterministic synthetic stand-in (per-class pattern +
+noise, generated shard-by-shard so memory stays bounded) exercises the
+identical shard/augment/batch pipeline.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from theanompi_tpu.models.data.base import Dataset
+
+# ImageNet channel means in [0,255] RGB (the reference subtracted a stored
+# per-pixel mean image; per-channel is the modern equivalent)
+MEAN_RGB = np.array([123.68, 116.78, 103.94], np.float32)
+STD_RGB = np.array([58.39, 57.12, 57.38], np.float32)
+
+
+def random_crop_mirror(x: np.ndarray, out: int, rng: np.random.RandomState):
+    """Random spatial crop to ``out`` + horizontal mirror (train augment)."""
+    n, h, w, _ = x.shape
+    ys = rng.randint(0, h - out + 1, n)
+    xs = rng.randint(0, w - out + 1, n)
+    flips = rng.rand(n) < 0.5
+    res = np.empty((n, out, out, x.shape[3]), x.dtype)
+    for i in range(n):
+        img = x[i, ys[i] : ys[i] + out, xs[i] : xs[i] + out]
+        res[i] = img[:, ::-1] if flips[i] else img
+    return res
+
+
+def center_crop(x: np.ndarray, out: int):
+    h, w = x.shape[1:3]
+    y0, x0 = (h - out) // 2, (w - out) // 2
+    return x[:, y0 : y0 + out, x0 : x0 + out]
+
+
+def normalize(x: np.ndarray) -> np.ndarray:
+    return (x.astype(np.float32) - MEAN_RGB) / STD_RGB
+
+
+def write_shards(dirpath: str, x: np.ndarray, y: np.ndarray, shard_size: int):
+    """Write arrays as the shard layout above (test/converter helper)."""
+    os.makedirs(dirpath, exist_ok=True)
+    for s, start in enumerate(range(0, len(x), shard_size)):
+        np.save(os.path.join(dirpath, f"x_{s:04d}.npy"), x[start : start + shard_size])
+        np.save(os.path.join(dirpath, f"y_{s:04d}.npy"), y[start : start + shard_size])
+
+
+def convert_hkl_tree(src: str, dst: str) -> None:
+    """Convert a reference-era hickle shard tree to the ``.npy`` layout.
+
+    Gated on the optional ``hickle`` dependency (not in this image).
+    """
+    try:
+        import hickle
+    except ImportError as e:
+        raise ImportError(
+            "hickle is not installed; convert_hkl_tree needs it to read "
+            ".hkl shards. Preprocess to .npy shards directly instead "
+            "(see write_shards)."
+        ) from e
+    os.makedirs(dst, exist_ok=True)
+    files = sorted(f for f in os.listdir(src) if f.endswith(".hkl"))
+    for i, f in enumerate(files):
+        arr = np.asarray(hickle.load(os.path.join(src, f)))
+        if arr.shape[1] == 3:  # reference stored CHW; we store HWC
+            arr = arr.transpose(0, 2, 3, 1)
+        np.save(os.path.join(dst, f"x_{i:04d}.npy"), arr.astype(np.uint8))
+
+
+class _ShardSet:
+    """One split: a list of (x, y) shard files iterated in shuffled order."""
+
+    def __init__(self, dirpath: str):
+        xs = sorted(f for f in os.listdir(dirpath) if f.startswith("x_"))
+        self.x_files = [os.path.join(dirpath, f) for f in xs]
+        self.y_files = [
+            os.path.join(dirpath, os.path.basename(p).replace("x_", "y_"))
+            for p in self.x_files
+        ]
+        missing = [p for p in self.y_files if not os.path.exists(p)]
+        if missing:
+            raise FileNotFoundError(f"label shards missing: {missing[:3]}")
+        self.n = sum(
+            int(np.load(p, mmap_mode="r").shape[0]) for p in self.x_files
+        )
+
+    def iter_shards(self, order):
+        for i in order:
+            yield np.load(self.x_files[i]), np.load(self.y_files[i])
+
+
+class _SyntheticShards:
+    """Deterministic synthetic shards, generated lazily (bounded memory).
+
+    Per-class signature: an 8×8×3 pattern seeded by the class id, tiled up to
+    ``store_size`` — learnable structure without a 1000×S²×3 mean table.
+    """
+
+    def __init__(self, n: int, n_classes: int, store_size: int,
+                 shard_size: int, seed: int):
+        self.n = n
+        self.n_classes = n_classes
+        self.store_size = store_size
+        self.shard_size = shard_size
+        self.seed = seed
+        self.n_shards = (n + shard_size - 1) // shard_size
+
+    def _pattern(self, cls: int) -> np.ndarray:
+        r = np.random.RandomState(1000003 + cls)
+        p = r.randint(60, 196, size=(8, 8, 3)).astype(np.float32)
+        reps = self.store_size // 8 + 1
+        return np.tile(p, (reps, reps, 1))[: self.store_size, : self.store_size]
+
+    def iter_shards(self, order):
+        for i in order:
+            count = min(self.shard_size, self.n - i * self.shard_size)
+            r = np.random.RandomState(self.seed * 7919 + i)
+            y = r.randint(0, self.n_classes, count).astype(np.int32)
+            x = np.empty((count, self.store_size, self.store_size, 3), np.uint8)
+            for j in range(count):
+                img = self._pattern(int(y[j])) + r.randn(
+                    self.store_size, self.store_size, 3
+                ).astype(np.float32) * 24.0
+                x[j] = np.clip(img, 0, 255).astype(np.uint8)
+            yield x, y
+
+
+class ImageNetData(Dataset):
+    """Sharded ImageNet(-style) data with crop/mirror augmentation.
+
+    Config keys: ``data_path`` (or ``$IMAGENET_PATH``), ``image_size`` (crop,
+    default 224), ``store_size`` (stored resolution, default 256; synthetic
+    only), ``n_classes`` (default 1000), and for the synthetic stand-in
+    ``n_train``/``n_val``/``shard_size``.
+    """
+
+    def __init__(self, config: dict | None = None):
+        config = config or {}
+        self.image_size = config.get("image_size", 224)
+        path = config.get("data_path") or os.environ.get("IMAGENET_PATH")
+        if path and os.path.isdir(os.path.join(path, "train")):
+            self.synthetic = False
+            self._train = _ShardSet(os.path.join(path, "train"))
+            self._val = _ShardSet(os.path.join(path, "val"))
+            probe = np.load(self._train.x_files[0], mmap_mode="r")
+            self.store_size = int(probe.shape[1])
+            if "n_classes" in config:
+                self.n_classes = config["n_classes"]
+            else:
+                # infer from BOTH splits: a sampled val set may lack the
+                # highest class id, and an undersized head silently clips
+                # labels in take_along_axis
+                ys = [
+                    np.load(p)
+                    for p in (*self._train.y_files, *self._val.y_files)
+                ]
+                self.n_classes = int(max(y.max() for y in ys)) + 1
+            self._train_shards = len(self._train.x_files)
+            self._val_shards = len(self._val.x_files)
+        else:
+            self.synthetic = True
+            self.store_size = config.get("store_size", max(self.image_size + 8, 64))
+            self.n_classes = config.get("n_classes", 1000)
+            shard = config.get("shard_size", 128)
+            self._train = _SyntheticShards(
+                config.get("n_train", 2048), self.n_classes, self.store_size,
+                shard, seed=1,
+            )
+            self._val = _SyntheticShards(
+                config.get("n_val", 512), self.n_classes, self.store_size,
+                shard, seed=2,
+            )
+            self._train_shards = self._train.n_shards
+            self._val_shards = self._val.n_shards
+        self.n_train = self._train.n
+        self.n_val = self._val.n
+        self.sample_shape = (self.image_size, self.image_size, 3)
+
+    # -- iteration -----------------------------------------------------------
+    def _batches(self, src, n_shards, batch_size, train: bool, rng=None):
+        """Shuffled-shard iteration with a rolling remainder buffer, so exact
+        constant-size batches are emitted across shard boundaries (the
+        reference's file_batch_size/n_subb bookkeeping)."""
+        order = rng.permutation(n_shards) if train else np.arange(n_shards)
+        buf_x: list[np.ndarray] = []
+        buf_y: list[np.ndarray] = []
+        have = 0
+        for x, y in src.iter_shards(order):
+            if train:
+                x = random_crop_mirror(x, self.image_size, rng)
+                within = rng.permutation(len(x))
+                x, y = x[within], y[within]
+            else:
+                x = center_crop(x, self.image_size)
+            buf_x.append(x)
+            buf_y.append(y)
+            have += len(x)
+            while have >= batch_size:
+                bx = np.concatenate(buf_x) if len(buf_x) > 1 else buf_x[0]
+                by = np.concatenate(buf_y) if len(buf_y) > 1 else buf_y[0]
+                yield {"x": normalize(bx[:batch_size]), "y": by[:batch_size]}
+                buf_x, buf_y = [bx[batch_size:]], [by[batch_size:]]
+                have -= batch_size
+        # ragged tail dropped (constant shapes under jit)
+
+    def train_batches(self, batch_size: int, epoch: int, seed: int = 0):
+        rng = np.random.RandomState(hash((seed, epoch)) % (2**31))
+        return self._batches(self._train, self._train_shards, batch_size,
+                             train=True, rng=rng)
+
+    def val_batches(self, batch_size: int):
+        return self._batches(self._val, self._val_shards, batch_size,
+                             train=False)

@@ -1,0 +1,260 @@
+"""DCGAN / WGAN under the rule framework (BASELINE.md config 5).
+
+Reference (unverified — SURVEY.md §2.1): ``theanompi/models/dcgan.py`` /
+``wgan.py`` — fork additions per BASELINE.json; Radford et al. 2015 DCGAN
+(strided-conv D, transposed-conv G, Adam lr=2e-4 β1=0.5) and Arjovsky et al.
+2017 WGAN (critic, weight clipping, RMSProp lr=5e-5), trained as a
+two-optimizer loop inside the data-parallel rules.
+
+The rules drive this model through :meth:`make_custom_step`: one compiled
+step updates the discriminator on (real, fake) then the generator through
+the frozen discriminator; under BSP both gradient sets are exchanged with
+the rule's collective, so GAN training data-parallelizes exactly like a
+classifier.  ``config["wgan"]=True`` switches losses, adds critic weight
+clipping, and runs ``n_critic`` critic steps per generator step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.models.contract import Model
+from theanompi_tpu.models.data.cifar10 import Cifar10Data
+from theanompi_tpu.ops import layers as L
+from theanompi_tpu.ops.initializers import normal
+from theanompi_tpu.ops.losses import sigmoid_binary_cross_entropy
+from theanompi_tpu.ops.opt import Adam, RMSProp
+from theanompi_tpu.parallel.mesh import DATA_AXIS, replica_rng
+
+
+class DCGAN(Model):
+    """Generator/discriminator pair on CIFAR-10-shaped images."""
+
+    default_config = {
+        "batch_size": 64,
+        "n_epochs": 25,
+        "lr": 2e-4,
+        "z_dim": 100,
+        "gen_base": 128,    # channels at the 4x4 stage
+        "disc_base": 64,
+        "image_size": 32,
+        "wgan": False,
+        "clip": 0.01,       # WGAN critic weight clip
+        "n_critic": 5,      # WGAN critic steps per generator step
+        "augment": False,   # GAN training uses raw images
+        "normalize": "tanh",  # reals in [-1,1], matching the tanh generator
+    }
+
+    def __init__(self, config=None):
+        super().__init__(config)
+        s = self.config["image_size"]
+        if s % 8 != 0:
+            raise ValueError(f"image_size must be divisible by 8, got {s}")
+        self.gen, self.disc = self._build_pair()
+
+    def build_data(self):
+        return Cifar10Data(self.config)
+
+    def build_optimizer(self):
+        if self.config["wgan"]:
+            return RMSProp()  # WGAN paper
+        return Adam(b1=0.5)   # DCGAN paper
+
+    def adjust_hyperp(self, epoch: int) -> float:
+        del epoch
+        return self.config.get("lr", 5e-5 if self.config["wgan"] else 2e-4)
+
+    # -- nets ----------------------------------------------------------------
+    def _build_pair(self):
+        cfg = self.config
+        gb, db = cfg["gen_base"], cfg["disc_base"]
+        s4 = cfg["image_size"] // 8  # spatial size at the deepest stage
+        w02 = normal(0.02)           # DCGAN-paper init
+        gen = L.Sequential((
+            L.Dense(s4 * s4 * gb * 2, w_init=w02),
+            _Reshape((s4, s4, gb * 2)),
+            L.BatchNorm(),
+            L.Activation("relu"),
+            L.ConvTranspose2D(gb, 4, stride=2, w_init=w02, use_bias=False),
+            L.BatchNorm(),
+            L.Activation("relu"),
+            L.ConvTranspose2D(gb // 2, 4, stride=2, w_init=w02, use_bias=False),
+            L.BatchNorm(),
+            L.Activation("relu"),
+            L.ConvTranspose2D(3, 4, stride=2, w_init=w02),
+            L.Activation("tanh"),
+        ))
+        disc = L.Sequential((
+            L.Conv2D(db, 4, stride=2, w_init=w02),
+            L.Activation("leaky_relu"),
+            L.Conv2D(db * 2, 4, stride=2, w_init=w02, use_bias=False),
+            L.BatchNorm(),
+            L.Activation("leaky_relu"),
+            L.Conv2D(db * 4, 4, stride=2, w_init=w02, use_bias=False),
+            L.BatchNorm(),
+            L.Activation("leaky_relu"),
+            L.Flatten(),
+            L.Dense(1, w_init=w02),
+        ))
+        return gen, disc
+
+    # -- contract ------------------------------------------------------------
+    def init_opt_state(self, optimizer, params):
+        return {
+            "gen": optimizer.init(params["gen"]),
+            "disc": optimizer.init(params["disc"]),
+        }
+
+    def init_params(self, rng):
+        kg, kd = jax.random.split(rng)
+        cfg = self.config
+        s = cfg["image_size"]
+        gp, gs, _ = self.gen.init(kg, (cfg["z_dim"],))
+        dp, ds, _ = self.disc.init(kd, (s, s, 3))
+        return {"gen": gp, "disc": dp}, {"gen": gs, "disc": ds}
+
+    def _sample(self, gen_params, gen_state, z, train):
+        x, new_gs = self.gen.apply(gen_params, gen_state, z, train=train)
+        return x, new_gs
+
+    def _d_loss(self, disc_params, disc_state, real, fake, train):
+        wgan = self.config["wgan"]
+        s_real, ns = self.disc.apply(disc_params, disc_state, real, train=train)
+        s_fake, ns = self.disc.apply(disc_params, ns, fake, train=train)
+        if wgan:
+            loss = jnp.mean(s_fake) - jnp.mean(s_real)  # critic maximizes gap
+        else:
+            loss = sigmoid_binary_cross_entropy(
+                s_real, jnp.ones_like(s_real)
+            ) + sigmoid_binary_cross_entropy(s_fake, jnp.zeros_like(s_fake))
+        return loss, ns
+
+    def _g_loss(self, gen_params, states, disc_params, z, train):
+        fake, new_gs = self._sample(gen_params, states["gen"], z, train)
+        s_fake, new_ds = self.disc.apply(disc_params, states["disc"], fake, train=train)
+        if self.config["wgan"]:
+            loss = -jnp.mean(s_fake)
+        else:
+            loss = sigmoid_binary_cross_entropy(s_fake, jnp.ones_like(s_fake))
+        return loss, (new_gs, new_ds)
+
+    def loss_fn(self, params, state, batch, rng, train: bool):
+        """Eval path for validate(): discriminator loss on (val-real, fake)."""
+        key = rng if rng is not None else jax.random.PRNGKey(0)
+        kz, _ = jax.random.split(key)
+        real = batch["x"].astype(self.precision.compute_dtype)
+        z = jax.random.normal(
+            kz, (real.shape[0], self.config["z_dim"]), real.dtype
+        )
+        cp = self.precision.cast_to_compute(params)
+        fake, _ = self._sample(cp["gen"], state["gen"], z, train=False)
+        d_loss, _ = self._d_loss(cp["disc"], state["disc"], real, fake, False)
+        return d_loss, (state, {"cost": d_loss})
+
+    # -- the two-optimizer compiled step -------------------------------------
+    def make_custom_step(self, opt, base_key, exchanger=None):
+        cfg = self.config
+        wgan = cfg["wgan"]
+        clip = cfg["clip"]
+
+        def exchange(g):
+            return exchanger.exchange(g) if exchanger is not None else g
+
+        def inner(params, state, opt_state, batch, lr, step):
+            rng = replica_rng(jax.random.fold_in(base_key, step), DATA_AXIS)
+            kz1, kz2 = jax.random.split(rng)
+            real = batch["x"].astype(self.precision.compute_dtype)
+            b = real.shape[0]
+            cast = self.precision.cast_to_compute
+
+            # discriminator/critic step (generator frozen)
+            z = jax.random.normal(kz1, (b, cfg["z_dim"]), real.dtype)
+            fake, gen_state = self._sample(
+                cast(params["gen"]), state["gen"], z, train=True
+            )
+            fake = lax_stop(fake)
+
+            def d_obj(dp):
+                loss, ns = self._d_loss(cast(dp), state["disc"], real, fake, True)
+                return loss, ns
+
+            (d_loss, disc_state), d_grads = jax.value_and_grad(
+                d_obj, has_aux=True
+            )(params["disc"])
+            d_grads = exchange(d_grads)
+            new_disc, new_dopt = opt.update(
+                d_grads, opt_state["disc"], params["disc"], lr
+            )
+            if wgan:
+                new_disc = jax.tree.map(
+                    lambda p: jnp.clip(p, -clip, clip), new_disc
+                )
+
+            # generator step through the (frozen) updated discriminator
+            z2 = jax.random.normal(kz2, (b, cfg["z_dim"]), real.dtype)
+
+            def g_obj(gp):
+                loss, (gs, _) = self._g_loss(
+                    cast(gp), {"gen": gen_state, "disc": disc_state},
+                    cast(new_disc), z2, True,
+                )
+                return loss, gs
+
+            (g_loss, gen_state2), g_grads = jax.value_and_grad(
+                g_obj, has_aux=True
+            )(params["gen"])
+            g_grads = exchange(g_grads)
+            new_gen, new_gopt = opt.update(
+                g_grads, opt_state["gen"], params["gen"], lr
+            )
+            if wgan:
+                # generator updates only every n_critic-th step; gate params
+                # AND optimizer state so its schedule matches the reference
+                # (zeroed-grad updates would still decay RMSProp's sq buffer)
+                do_g = jnp.equal(jnp.mod(step, cfg["n_critic"]), 0)
+                keep = lambda new, old: jax.tree.map(
+                    lambda a, b: jnp.where(do_g, a, b), new, old
+                )
+                new_gen = keep(new_gen, params["gen"])
+                new_gopt = keep(new_gopt, opt_state["gen"])
+
+            new_params = {"gen": new_gen, "disc": new_disc}
+            new_state = {"gen": gen_state2, "disc": disc_state}
+            new_opt = {"gen": new_gopt, "disc": new_dopt}
+            metrics = {
+                "cost": d_loss + g_loss,
+                "d_loss": d_loss,
+                "g_loss": g_loss,
+            }
+            return new_params, new_state, new_opt, metrics
+
+        return inner
+
+
+def lax_stop(x):
+    return jax.lax.stop_gradient(x)
+
+
+class _Reshape(L.Layer):
+    """Reshape trailing dims (generator stem: dense → spatial map)."""
+
+    def __init__(self, shape):
+        self.target = tuple(shape)
+
+    def init(self, key, in_shape):
+        del key
+        import numpy as np
+
+        if int(np.prod(in_shape)) != int(np.prod(self.target)):
+            raise ValueError(f"cannot reshape {in_shape} -> {self.target}")
+        return {}, {}, self.target
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return x.reshape(x.shape[0], *self.target), state
+
+
+class WGAN(DCGAN):
+    """WGAN as its own class for import-by-string parity."""
+
+    default_config = {**DCGAN.default_config, "wgan": True, "lr": 5e-5}

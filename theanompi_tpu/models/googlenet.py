@@ -1,0 +1,136 @@
+"""GoogLeNet / Inception-v1 (BASELINE.md config 3).
+
+Reference (unverified — SURVEY.md §2.1): ``theanompi/models/googlenet.py`` —
+Szegedy et al. 2014: stem (7x7/2 conv, LRN-era norms), nine inception
+modules (1x1 / 1x1→3x3 / 1x1→5x5 / pool→1x1 branches, channel-concat),
+global average pool, FC.  The paper's auxiliary classifiers existed only to
+help 2014-era optimization; they are off by default here (``aux=False``) —
+with BN available ("bn": True) they are unnecessary, and omitting them keeps
+the training graph a single path XLA fuses well.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.models.contract import SupervisedModel
+from theanompi_tpu.models.data.imagenet import ImageNetData
+from theanompi_tpu.ops import initializers as init_lib
+from theanompi_tpu.ops import layers as L
+
+
+def _branch(*layers: L.Layer) -> L.Sequential:
+    return L.Sequential(tuple(layers))
+
+
+@dataclasses.dataclass(frozen=True)
+class _Inception(L.Layer):
+    """Four parallel branches, concatenated on channels.
+
+    ``spec`` = (n1x1, n3x3_reduce, n3x3, n5x5_reduce, n5x5, pool_proj).
+    """
+
+    spec: tuple
+    lrn: bool = False
+
+    def _branches(self):
+        n1, r3, n3, r5, n5, pp = self.spec
+        relu = L.Activation("relu")
+        return (
+            _branch(L.Conv2D(n1, 1), relu),
+            _branch(L.Conv2D(r3, 1), relu, L.Conv2D(n3, 3, padding=1), relu),
+            _branch(L.Conv2D(r5, 1), relu, L.Conv2D(n5, 5, padding=2), relu),
+            _branch(L.MaxPool(3, stride=1, padding="SAME"), L.Conv2D(pp, 1), relu),
+        )
+
+    def init(self, key, in_shape):
+        keys = jax.random.split(key, 4)
+        params, state = {}, {}
+        out_c = 0
+        for i, (b, k) in enumerate(zip(self._branches(), keys)):
+            p, s, shape = b.init(k, in_shape)
+            if p:
+                params[f"b{i}"] = p
+            if s:
+                state[f"b{i}"] = s
+            out_c += shape[-1]
+        return params, state, (*in_shape[:-1], out_c)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        new_state = dict(state)
+        outs = []
+        for i, b in enumerate(self._branches()):
+            y, s = b.apply(
+                params.get(f"b{i}", {}), state.get(f"b{i}", {}), x, train=train
+            )
+            if s:
+                new_state[f"b{i}"] = s
+            outs.append(y)
+        return jnp.concatenate(outs, axis=-1), new_state
+
+
+# (module name, spec) in network order, with 'P' = 3x3/2 max-pool
+_PLAN = (
+    ("3a", (64, 96, 128, 16, 32, 32)),
+    ("3b", (128, 128, 192, 32, 96, 64)),
+    "P",
+    ("4a", (192, 96, 208, 16, 48, 64)),
+    ("4b", (160, 112, 224, 24, 64, 64)),
+    ("4c", (128, 128, 256, 24, 64, 64)),
+    ("4d", (112, 144, 288, 32, 64, 64)),
+    ("4e", (256, 160, 320, 32, 128, 128)),
+    "P",
+    ("5a", (256, 160, 320, 32, 128, 128)),
+    ("5b", (384, 192, 384, 48, 128, 128)),
+)
+
+
+class GoogLeNet(SupervisedModel):
+    default_config = {
+        "batch_size": 32,
+        "n_epochs": 80,
+        "lr": 0.01,
+        "lr_decay_epochs": (30, 55, 70),
+        "lr_decay_factor": 0.1,
+        "momentum": 0.9,
+        "weight_decay": 2e-4,
+        "image_size": 224,
+        "n_classes": 1000,
+        "lrn": True,
+        "dropout": 0.4,
+    }
+
+    def build_data(self):
+        return ImageNetData(self.config)
+
+    def build_net(self):
+        cfg = self.config
+        relu = L.Activation("relu")
+        maybe_lrn = [L.LRN(size=5)] if cfg["lrn"] else []
+        layers: list[L.Layer] = [
+            L.Conv2D(64, 7, stride=2, padding=3),
+            relu,
+            L.MaxPool(3, stride=2, padding="SAME"),
+            *maybe_lrn,
+            L.Conv2D(64, 1),
+            relu,
+            L.Conv2D(192, 3, padding=1),
+            relu,
+            *maybe_lrn,
+            L.MaxPool(3, stride=2, padding="SAME"),
+        ]
+        for item in _PLAN:
+            if item == "P":
+                layers.append(L.MaxPool(3, stride=2, padding="SAME"))
+            else:
+                layers.append(_Inception(item[1]))
+        layers += [
+            L.GlobalAvgPool(),
+            L.Dropout(cfg["dropout"]),
+            L.Dense(cfg["n_classes"], w_init=init_lib.glorot_normal),
+        ]
+        s = cfg["image_size"]
+        return L.Sequential(layers), (s, s, 3)

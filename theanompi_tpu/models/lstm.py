@@ -1,0 +1,127 @@
+"""Word-level LSTM language model (BASELINE.md config 5 — PTB lineage).
+
+Reference (unverified — SURVEY.md §2.1): ``theanompi/models/lstm.py``, from
+the Theano-tutorial PTB LM lineage: embedding → LSTM stack (BPTT) → softmax
+over the vocabulary, perplexity-tracked.
+
+Real PTB loads from ``$PTB_PATH``/``config["data_path"]`` pointing at a dir
+with ``ptb.train.txt``/``ptb.valid.txt`` (space-tokenized words); otherwise a
+synthetic bigram-structured stream stands in (zero-egress image), exercising
+the identical pipeline.  The time dimension runs under ``lax.scan`` (the
+compiled analogue of Theano ``scan`` BPTT); the input projection is hoisted
+out of the scan to keep the MXU busy (see ops.layers.LSTM).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from theanompi_tpu.models.contract import SupervisedModel
+from theanompi_tpu.models.data.base import Dataset, SyntheticSequenceDataset
+from theanompi_tpu.ops import initializers as init_lib
+from theanompi_tpu.ops import layers as L
+
+
+class PTBData(Dataset):
+    """Contiguous token stream chopped into [B, T] next-word batches."""
+
+    def __init__(self, config: dict | None = None):
+        config = config or {}
+        self.seq_len = config.get("seq_len", 35)
+        path = config.get("data_path") or os.environ.get("PTB_PATH")
+        if path and os.path.exists(os.path.join(path, "ptb.train.txt")):
+            self.synthetic = False
+            train_words = open(os.path.join(path, "ptb.train.txt")).read().split()
+            val_words = open(os.path.join(path, "ptb.valid.txt")).read().split()
+            vocab = sorted(set(train_words)) + ["<unk2>"]
+            self.word_to_id = {w: i for i, w in enumerate(vocab)}
+            unk = len(vocab) - 1
+            self.vocab = len(vocab)
+            train_ids = np.array(
+                [self.word_to_id.get(w, unk) for w in train_words], np.int32
+            )
+            val_ids = np.array(
+                [self.word_to_id.get(w, unk) for w in val_words], np.int32
+            )
+            self._train_seqs = self._chop(train_ids)
+            self._val_seqs = self._chop(val_ids)
+        else:
+            self.synthetic = True
+            syn = SyntheticSequenceDataset(
+                n_train=config.get("n_train", 512),
+                n_val=config.get("n_val", 128),
+                seq_len=self.seq_len,
+                vocab=config.get("vocab", 256),
+            )
+            self.vocab = syn.vocab
+            self._train_seqs = syn._train
+            self._val_seqs = syn._val
+        self.n_classes = self.vocab
+        self.n_train = len(self._train_seqs)
+        self.n_val = len(self._val_seqs)
+        self.sample_shape = (self.seq_len,)
+
+    def _chop(self, ids: np.ndarray) -> np.ndarray:
+        t = self.seq_len + 1  # +1: targets are inputs shifted by one
+        n = len(ids) // t
+        return ids[: n * t].reshape(n, t)
+
+    def train_batches(self, batch_size: int, epoch: int, seed: int = 0):
+        rng = np.random.RandomState(hash((seed, epoch)) % (2**31))
+        order = rng.permutation(self.n_train)
+        for i in range(self.n_train // batch_size):
+            s = self._train_seqs[order[i * batch_size : (i + 1) * batch_size]]
+            yield {"x": s[:, :-1], "y": s[:, 1:]}
+
+    def val_batches(self, batch_size: int):
+        for i in range(self.n_val // batch_size):
+            s = self._val_seqs[i * batch_size : (i + 1) * batch_size]
+            yield {"x": s[:, :-1], "y": s[:, 1:]}
+
+
+class LSTM(SupervisedModel):
+    """PTB-style LM.  ``error`` in metrics is next-word top-1 error;
+    ``perplexity`` = exp(loss) is appended for the reference's headline LM
+    metric."""
+
+    default_config = {
+        "batch_size": 32,
+        "n_epochs": 13,
+        "lr": 1.0,        # the tutorial-era SGD schedule
+        "lr_decay_epochs": (4, 6, 8, 10, 12),
+        "lr_decay_factor": 0.5,
+        "momentum": 0.0,
+        "seq_len": 35,
+        "hidden": 650,
+        "n_layers": 2,
+        "embed_dim": 650,
+        "dropout": 0.5,
+        "grad_clip": 5.0,
+    }
+
+    def build_data(self):
+        return PTBData(self.config)
+
+    def build_net(self):
+        cfg = self.config
+        layers: list[L.Layer] = [
+            L.Embedding(self.data.vocab, cfg["embed_dim"]),
+        ]
+        for _ in range(cfg["n_layers"]):
+            layers += [L.Dropout(cfg["dropout"]), L.LSTM(cfg["hidden"])]
+        layers += [
+            L.Dropout(cfg["dropout"]),
+            L.Dense(self.data.vocab, w_init=init_lib.glorot_normal),
+        ]
+        return L.Sequential(layers), (cfg["seq_len"],)
+
+    def loss_fn(self, params, state, batch, rng, train: bool):
+        loss, (new_state, metrics) = super().loss_fn(
+            params, state, batch, rng, train
+        )
+        metrics = dict(metrics)
+        metrics["perplexity"] = jnp.exp(metrics["cost"])
+        return loss, (new_state, metrics)

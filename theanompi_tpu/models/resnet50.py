@@ -1,0 +1,141 @@
+"""ResNet-50 (BASELINE.md config 4 — the EASGD / north-star model).
+
+Reference (unverified — SURVEY.md §2.1): ``theanompi/models/resnet50.py`` or
+``lasagne_model_zoo/resnet50.py`` [MED]; He et al. 2015: 7x7/2 stem, 3x3/2
+max-pool, four stages of bottleneck blocks (3/4/6/3) with post-activation
+BN-ReLU, global average pool, FC-1000.
+
+TPU notes: bottleneck 1x1-3x3-1x1 convs are exactly MXU-shaped; BN runs in
+fp32 with optional cross-replica stats (``bn_axis``); the final BN of each
+block is zero-init (``bn_scale_zero``) so residual branches start as
+identity — the standard large-batch trick, on by default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from theanompi_tpu.models.contract import SupervisedModel
+from theanompi_tpu.models.data.imagenet import ImageNetData
+from theanompi_tpu.ops import initializers as init_lib
+from theanompi_tpu.ops import layers as L
+
+
+@dataclasses.dataclass(frozen=True)
+class _Bottleneck(L.Layer):
+    """1x1 reduce → 3x3 → 1x1 expand, post-activation BN, projection shortcut."""
+
+    filters: int          # bottleneck width; output is 4x
+    stride: int = 1
+    bn_axis: str | None = None
+    zero_init_last: bool = True
+
+    def _subs(self):
+        f = self.filters
+        last_scale = init_lib.zeros if self.zero_init_last else init_lib.ones
+        return (
+            ("conv1", L.Conv2D(f, 1, use_bias=False)),
+            ("bn1", L.BatchNorm(axis_name=self.bn_axis)),
+            ("conv2", L.Conv2D(f, 3, stride=self.stride, padding=1, use_bias=False)),
+            ("bn2", L.BatchNorm(axis_name=self.bn_axis)),
+            ("conv3", L.Conv2D(4 * f, 1, use_bias=False)),
+            ("bn3", L.BatchNorm(axis_name=self.bn_axis, scale_init=last_scale)),
+        )
+
+    def _proj(self):
+        return (
+            ("proj", L.Conv2D(4 * self.filters, 1, stride=self.stride,
+                              use_bias=False)),
+            ("proj_bn", L.BatchNorm(axis_name=self.bn_axis)),
+        )
+
+    def init(self, key, in_shape):
+        subs = list(self._subs())
+        need_proj = in_shape[-1] != 4 * self.filters or self.stride != 1
+        if need_proj:
+            subs += list(self._proj())
+        keys = jax.random.split(key, len(subs))
+        params, state = {}, {}
+        shape = in_shape
+        proj_shape = in_shape
+        for (name, layer), k in zip(subs, keys):
+            src = proj_shape if name.startswith("proj") else shape
+            p, s, out = layer.init(k, src)
+            if name.startswith("proj"):
+                proj_shape = out
+            else:
+                shape = out
+            if p:
+                params[name] = p
+            if s:
+                state[name] = s
+        return params, state, shape
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        new_state = dict(state)
+        h = x
+        for name, layer in self._subs():
+            h, s = layer.apply(
+                params.get(name, {}), state.get(name, {}), h, train=train
+            )
+            if s:
+                new_state[name] = s
+            if name in ("bn1", "bn2"):
+                h = jax.nn.relu(h)
+        shortcut = x
+        if "proj" in params:
+            for name, layer in self._proj():
+                shortcut, s = layer.apply(
+                    params.get(name, {}), state.get(name, {}), shortcut,
+                    train=train,
+                )
+                if s:
+                    new_state[name] = s
+        return jax.nn.relu(h + shortcut), new_state
+
+
+class ResNet50(SupervisedModel):
+    default_config = {
+        "batch_size": 64,
+        "n_epochs": 90,
+        "lr": 0.1,
+        "lr_decay_epochs": (30, 60, 80),
+        "lr_decay_factor": 0.1,
+        "momentum": 0.9,
+        "weight_decay": 1e-4,
+        "nesterov": True,
+        "image_size": 224,
+        "n_classes": 1000,
+        "bn_axis": None,
+        "bn_scale_zero": True,
+        "stage_blocks": (3, 4, 6, 3),  # -> ResNet-50
+    }
+
+    def build_data(self):
+        return ImageNetData(self.config)
+
+    def build_net(self):
+        cfg = self.config
+        bn_axis = cfg["bn_axis"]
+        layers: list[L.Layer] = [
+            L.Conv2D(64, 7, stride=2, padding=3, use_bias=False),
+            L.BatchNorm(axis_name=bn_axis),
+            L.Activation("relu"),
+            L.MaxPool(3, stride=2, padding="SAME"),
+        ]
+        widths = (64, 128, 256, 512)
+        for stage, (w, blocks) in enumerate(zip(widths, cfg["stage_blocks"])):
+            for i in range(blocks):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                layers.append(
+                    _Bottleneck(w, stride=stride, bn_axis=bn_axis,
+                                zero_init_last=cfg["bn_scale_zero"])
+                )
+        layers += [
+            L.GlobalAvgPool(),
+            L.Dense(cfg["n_classes"], w_init=init_lib.glorot_normal),
+        ]
+        s = cfg["image_size"]
+        return L.Sequential(layers), (s, s, 3)

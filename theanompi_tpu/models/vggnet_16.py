@@ -1,0 +1,76 @@
+"""VGG-16 (BASELINE.md config 3, with the reference's shallow VGG-11 variant).
+
+Reference (unverified — SURVEY.md §2.1): ``theanompi/models/vggnet_16.py``
+plus a ``vggnet_11_shallow`` variant [LOW confidence]; Simonyan & Zisserman
+2014 configuration D (13 conv + 3 FC) / A (8 conv + 3 FC).
+
+``config["shallow"]=True`` selects VGG-11.  BN is off by default (parity with
+the paper-era reference); ``config["bn"]=True`` inserts BatchNorm after every
+conv (the modern trainable-at-scale variant, sync across ``bn_axis``).
+"""
+
+from __future__ import annotations
+
+from theanompi_tpu.models.contract import SupervisedModel
+from theanompi_tpu.models.data.imagenet import ImageNetData
+from theanompi_tpu.ops import initializers as init_lib
+from theanompi_tpu.ops import layers as L
+
+# conv widths per stage; 'M' = 2x2 max-pool
+_VGG16 = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+          512, 512, 512, "M", 512, 512, 512, "M")
+_VGG11 = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M")
+
+
+class VGGNet_16(SupervisedModel):
+    default_config = {
+        "batch_size": 64,
+        "n_epochs": 74,
+        "lr": 0.01,
+        "lr_decay_epochs": (50, 65),
+        "lr_decay_factor": 0.1,
+        "momentum": 0.9,
+        "weight_decay": 5e-4,
+        "image_size": 224,
+        "n_classes": 1000,
+        "dropout": 0.5,
+        "shallow": False,
+        "bn": False,
+        "bn_axis": None,
+        "fc_width": 4096,
+    }
+
+    def build_data(self):
+        return ImageNetData(self.config)
+
+    def build_net(self):
+        cfg = self.config
+        plan = _VGG11 if cfg["shallow"] else _VGG16
+        layers: list[L.Layer] = []
+        for item in plan:
+            if item == "M":
+                layers.append(L.MaxPool(2, stride=2))
+                continue
+            layers.append(L.Conv2D(item, 3, padding=1, use_bias=not cfg["bn"]))
+            if cfg["bn"]:
+                layers.append(L.BatchNorm(axis_name=cfg["bn_axis"]))
+            layers.append(L.Activation("relu"))
+        w = cfg["fc_width"]
+        layers += [
+            L.Flatten(),
+            L.Dense(w),
+            L.Activation("relu"),
+            L.Dropout(cfg["dropout"]),
+            L.Dense(w),
+            L.Activation("relu"),
+            L.Dropout(cfg["dropout"]),
+            L.Dense(cfg["n_classes"], w_init=init_lib.glorot_normal),
+        ]
+        s = cfg["image_size"]
+        return L.Sequential(layers), (s, s, 3)
+
+
+class VGGNet_11_Shallow(VGGNet_16):
+    """Reference's shallow variant as its own class (import-by-string)."""
+
+    default_config = {**VGGNet_16.default_config, "shallow": True}

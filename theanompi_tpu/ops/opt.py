@@ -22,12 +22,33 @@ def _tmap(fn, *trees):
     return jax.tree.map(fn, *trees)
 
 
+def clip_by_global_norm(grads, max_norm: float):
+    """Scale the whole gradient pytree so its global L2 norm <= max_norm
+    (the tutorial-era LSTM BPTT stabilizer; reference lstm.py lineage)."""
+    norm = jnp.sqrt(
+        sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree.leaves(grads))
+    )
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return _tmap(lambda g: (g * scale).astype(g.dtype), grads)
+
+
 class Optimizer:
+    #: defaults for the _preprocess contract; subclasses carry the fields
+    grad_clip: float | None = None
+    weight_decay: float = 0.0
+
     def init(self, params):
         raise NotImplementedError
 
     def update(self, grads, opt_state, params, lr):
         raise NotImplementedError
+
+    def _preprocess(self, grads, params):
+        if self.grad_clip:
+            grads = clip_by_global_norm(grads, self.grad_clip)
+        if self.weight_decay:
+            grads = _tmap(lambda g, p: g + self.weight_decay * p, grads, params)
+        return grads
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,6 +62,7 @@ class SGD(Optimizer):
     momentum: float = 0.0
     nesterov: bool = False
     weight_decay: float = 0.0
+    grad_clip: float | None = None
 
     def init(self, params):
         if self.momentum == 0.0:
@@ -48,8 +70,7 @@ class SGD(Optimizer):
         return {"velocity": _tmap(jnp.zeros_like, params)}
 
     def update(self, grads, opt_state, params, lr):
-        if self.weight_decay:
-            grads = _tmap(lambda g, p: g + self.weight_decay * p, grads, params)
+        grads = self._preprocess(grads, params)
         if self.momentum == 0.0:
             new_params = _tmap(lambda p, g: p - lr * g, params, grads)
             return new_params, opt_state
@@ -72,6 +93,7 @@ class Adam(Optimizer):
     b2: float = 0.999
     eps: float = 1e-8
     weight_decay: float = 0.0
+    grad_clip: float | None = None
 
     def init(self, params):
         return {
@@ -81,8 +103,7 @@ class Adam(Optimizer):
         }
 
     def update(self, grads, opt_state, params, lr):
-        if self.weight_decay:
-            grads = _tmap(lambda g, p: g + self.weight_decay * p, grads, params)
+        grads = self._preprocess(grads, params)
         t = opt_state["t"] + 1
         m = _tmap(lambda m, g: self.b1 * m + (1 - self.b1) * g, opt_state["m"], grads)
         v = _tmap(
@@ -104,11 +125,14 @@ class RMSProp(Optimizer):
 
     decay: float = 0.9
     eps: float = 1e-8
+    weight_decay: float = 0.0
+    grad_clip: float | None = None
 
     def init(self, params):
         return {"sq": _tmap(jnp.zeros_like, params)}
 
     def update(self, grads, opt_state, params, lr):
+        grads = self._preprocess(grads, params)
         sq = _tmap(
             lambda s, g: self.decay * s + (1 - self.decay) * jnp.square(g),
             opt_state["sq"], grads,

@@ -82,7 +82,7 @@ class BSPTrainer(BaseTrainer):
         params, state = self.model.init_params(jax.random.PRNGKey(self.seed + 1))
         self.params = replicate(self.mesh, params)
         self.state = replicate(self.mesh, state)
-        self.opt_state = replicate(self.mesh, self.optimizer.init(params))
+        self.opt_state = replicate(self.mesh, self.model.init_opt_state(self.optimizer, params))
 
 
 class BSP(Rule):

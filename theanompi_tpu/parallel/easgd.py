@@ -123,7 +123,7 @@ class EASGDTrainer(BaseTrainer):
         n = self.n_workers
         self.params = stack_for_workers(self.mesh, params, n)
         self.state = stack_for_workers(self.mesh, state, n)
-        self.opt_state = stack_for_workers(self.mesh, self.optimizer.init(params), n)
+        self.opt_state = stack_for_workers(self.mesh, self.model.init_opt_state(self.optimizer, params), n)
         self.center = replicate(self.mesh, params)
 
     def post_step(self) -> None:

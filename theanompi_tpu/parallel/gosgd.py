@@ -155,7 +155,7 @@ class GOSGDTrainer(BaseTrainer):
         n = self.n_workers
         self.params = stack_for_workers(self.mesh, params, n)
         self.state = stack_for_workers(self.mesh, state, n)
-        self.opt_state = stack_for_workers(self.mesh, self.optimizer.init(params), n)
+        self.opt_state = stack_for_workers(self.mesh, self.model.init_opt_state(self.optimizer, params), n)
         self.weights = jax.device_put(
             np.full((n,), 1.0 / n, np.float32), NamedSharding(self.mesh, P(DATA_AXIS))
         )

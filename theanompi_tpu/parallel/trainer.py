@@ -58,22 +58,32 @@ def make_local_step(model, opt, base_key, exchanger=None, stacked=False):
     come back per-worker (stacked) — averaging happens on host at print time.
     """
 
+    # models with a non-standard update (e.g. the GAN two-optimizer step)
+    # supply the whole inner step; the rule still owns layout and reduction
+    custom = getattr(model, "make_custom_step", None)
+    inner = custom(opt, base_key, exchanger) if custom is not None else None
+
     def local_step(params, state, opt_state, batch, lr, step):
         if stacked:
             params, state, opt_state = (
                 unstack(params), unstack(state), unstack(opt_state)
             )
-        rng = replica_rng(jax.random.fold_in(base_key, step), DATA_AXIS)
+        if inner is not None:
+            new_params, new_state, new_opt_state, metrics = inner(
+                params, state, opt_state, batch, lr, step
+            )
+        else:
+            rng = replica_rng(jax.random.fold_in(base_key, step), DATA_AXIS)
 
-        def lossw(p):
-            return model.loss_fn(p, state, batch, rng, train=True)
+            def lossw(p):
+                return model.loss_fn(p, state, batch, rng, train=True)
 
-        (_, (new_state, metrics)), grads = jax.value_and_grad(
-            lossw, has_aux=True
-        )(params)
-        if exchanger is not None:
-            grads = exchanger.exchange(grads)
-        new_params, new_opt_state = opt.update(grads, opt_state, params, lr)
+            (_, (new_state, metrics)), grads = jax.value_and_grad(
+                lossw, has_aux=True
+            )(params)
+            if exchanger is not None:
+                grads = exchanger.exchange(grads)
+            new_params, new_opt_state = opt.update(grads, opt_state, params, lr)
         if stacked:
             return (
                 restack(new_params),
@@ -125,12 +135,13 @@ class BaseTrainer:
     """
 
     def __init__(self, model, mesh=None, recorder: Recorder | None = None,
-                 seed: int = 0):
+                 seed: int = 0, prefetch_depth: int = 2):
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh(n_data=1)
         self.n_workers = self.mesh.shape[DATA_AXIS]
         self.recorder = recorder or Recorder()
         self.seed = seed
+        self.prefetch_depth = prefetch_depth
         self.optimizer = model.build_optimizer()
         self.global_batch = model.batch_size * self.n_workers
         self._step_fn = None
@@ -159,6 +170,7 @@ class BaseTrainer:
     def train_iter(self, batch: dict, lr: float, recorder: Recorder | None = None):
         r = recorder or self.recorder
         r.start("wait")
+        # already-placed batches (prefetch path) pass through device_put free
         batch = shard_batch(self.mesh, batch)
         r.end("wait")
         r.start("calc")
@@ -218,15 +230,28 @@ class BaseTrainer:
             self.compile_iter_fns()
         if self.params is None:
             self.init_state()
+        from theanompi_tpu.models.data.prefetch import prefetch
+
         model = self.model
         for epoch in range(self.epoch, model.n_epochs):
             self.epoch = epoch
             self.recorder.start_epoch()
             lr = model.adjust_hyperp(epoch)
-            for batch in model.data.train_batches(
-                self.global_batch, epoch, seed=self.seed
-            ):
-                self.train_iter(batch, lr)
+            # para_load equivalent: read/augment/transfer overlaps compute
+            batches = prefetch(
+                model.data.train_batches(self.global_batch, epoch, seed=self.seed),
+                mesh=self.mesh,
+                depth=self.prefetch_depth,
+            )
+            try:
+                for batch in batches:
+                    self.train_iter(batch, lr)
+            finally:
+                # a step failure must not leave the loader thread pinning
+                # device batches
+                close = getattr(batches, "close", None)
+                if close is not None:
+                    close()
             self.validate(epoch)
             self.epoch = epoch + 1  # resume point: next epoch, not this one
         self.recorder.save()

@@ -38,7 +38,10 @@ def shard_batch(mesh: Mesh, batch: dict, axis: str = DATA_AXIS) -> dict:
     """Place a host batch on the mesh, leading dim split over ``axis``."""
 
     def put(x):
-        x = np.asarray(x)
+        if not isinstance(x, jax.Array):
+            # np.asarray would silently pull an already-placed (prefetched)
+            # batch back to host; device_put below is a no-op for those
+            x = np.asarray(x)
         spec = P(axis, *([None] * (x.ndim - 1)))
         return jax.device_put(x, NamedSharding(mesh, spec))
 
