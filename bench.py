@@ -50,8 +50,7 @@ def build_trainer(model_name: str):
 
 
 def main():
-    # default flips to resnet50 when that model lands in the zoo
-    model_name = os.environ.get("BENCH_MODEL", "wide_resnet")
+    model_name = os.environ.get("BENCH_MODEL", "resnet50")
     trainer, model = build_trainer(model_name)
     platform = jax.devices()[0].platform
     steps = int(os.environ.get("BENCH_STEPS", "30" if platform == "tpu" else "10"))

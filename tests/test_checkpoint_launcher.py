@@ -1,0 +1,115 @@
+"""Checkpoint/resume + tmlauncher CLI tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from theanompi_tpu.launcher import main as tm_main
+from theanompi_tpu.utils.checkpoint import Checkpointer
+
+TINY = {"depth": 10, "widen": 1, "batch_size": 8, "image_size": 16,
+        "n_train": 128, "n_val": 64, "n_epochs": 2, "precision": "fp32",
+        "lr": 0.05}
+
+
+def test_checkpointer_roundtrip(tmp_path):
+    ck = Checkpointer(str(tmp_path), keep=2)
+    tree = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "b": {"c": np.ones((4,), np.int32)}}
+    ck.save(0, 10, {"params": tree})
+    ck.save(1, 20, {"params": tree})
+    ck.save(2, 30, {"params": tree})
+    # retention: only 2 newest kept
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".npz")]
+    assert len(files) == 2
+    assert ck.latest_epoch() == 2 and ck.latest_iteration() == 30
+
+    template = {"a": np.zeros((2, 3), np.float32),
+                "b": {"c": np.zeros((4,), np.int32)}}
+    out = ck.load(2, {"params": template})["params"]
+    np.testing.assert_array_equal(out["a"], tree["a"])
+    np.testing.assert_array_equal(out["b"]["c"], tree["b"]["c"])
+
+
+def test_checkpointer_shape_mismatch(tmp_path):
+    ck = Checkpointer(str(tmp_path))
+    ck.save(0, 1, {"params": {"a": np.zeros((2,), np.float32)}})
+    with pytest.raises(ValueError, match="shape"):
+        ck.load(0, {"params": {"a": np.zeros((3,), np.float32)}})
+
+
+@pytest.mark.slow
+def test_bsp_resume_continues_state(tmp_path, mesh8):
+    """Train 2 epochs with checkpointing; resume restores params exactly."""
+    from theanompi_tpu import BSP
+
+    cfg = {"verbose": False, "print_freq": 4,
+           "checkpoint_dir": str(tmp_path / "ck")}
+    rule = BSP(config=cfg)
+    rule.init(devices=8, modelfile="theanompi_tpu.models.wide_resnet",
+              modelclass="WideResNet", model_config=dict(TINY))
+    rule.wait()
+    params_after = jax.tree.map(np.asarray, rule.trainer.params)
+    iters_after = rule.trainer.iteration
+
+    rule2 = BSP(config={**cfg, "resume": True})
+    rule2.init(devices=8, modelfile="theanompi_tpu.models.wide_resnet",
+               modelclass="WideResNet", model_config=dict(TINY))
+    t2 = rule2.trainer
+    assert t2.epoch == TINY["n_epochs"], "resume should start after last epoch"
+    assert t2.iteration == iters_after
+    for a, b in zip(jax.tree.leaves(t2.params), jax.tree.leaves(params_after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # wait() is a no-op now (all epochs done) and must not crash
+    rule2.wait()
+
+
+@pytest.mark.slow
+def test_easgd_checkpoint_includes_center(tmp_path):
+    from theanompi_tpu import EASGD
+
+    cfg = {"verbose": False, "tau": 2, "scale_lr": False,
+           "checkpoint_dir": str(tmp_path / "ck")}
+    rule = EASGD(config=cfg)
+    rule.init(devices=8, modelfile="theanompi_tpu.models.wide_resnet",
+              modelclass="WideResNet", model_config={**TINY, "n_epochs": 1})
+    rule.wait()
+    center = jax.tree.map(np.asarray, rule.trainer.center)
+
+    rule2 = EASGD(config={**cfg, "resume": True})
+    rule2.init(devices=8, modelfile="theanompi_tpu.models.wide_resnet",
+               modelclass="WideResNet", model_config={**TINY, "n_epochs": 1})
+    for a, b in zip(jax.tree.leaves(rule2.trainer.center),
+                    jax.tree.leaves(center)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_launcher_kv_parsing():
+    from theanompi_tpu.launcher import _parse_kv
+
+    d = _parse_kv(["lr=0.1", "lrn=False", "stage_blocks=(1,1,1,1)",
+                   "name=foo"])
+    assert d == {"lr": 0.1, "lrn": False, "stage_blocks": (1, 1, 1, 1),
+                 "name": "foo"}
+    with pytest.raises(SystemExit):
+        _parse_kv(["novalue"])
+
+
+@pytest.mark.slow
+def test_launcher_end_to_end(tmp_path, capsys):
+    rc = tm_main([
+        "--rule", "BSP", "--devices", "4",
+        "--modelfile", "theanompi_tpu.models.wide_resnet",
+        "--modelclass", "WideResNet",
+        "--set", "depth=10", "--set", "widen=1", "--set", "batch_size=8",
+        "--set", "image_size=16", "--set", "n_train=64", "--set", "n_val=32",
+        "--set", "n_epochs=1", "--set", "precision='fp32'",
+        "--record-dir", str(tmp_path / "rec"),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "tmlauncher: done" in out
+    assert os.path.exists(tmp_path / "rec" / "summary.json")

@@ -1,0 +1,131 @@
+"""tmlauncher: the CLI session launcher.
+
+Reference (unverified — SURVEY.md §1/§3.1): ``tmlauncher``/``launch_session.py``
+composed an ``mpirun`` command line placing one worker process per requested
+``cudaN`` device (plus the EASGD server rank) and joined it.
+
+TPU-native re-expression: there is no process tree to compose — the "cluster"
+is the device mesh.  The launcher parses the same launch intent
+(rule, device count, modelfile/modelclass, config) and drives
+``Rule.init(...).wait()`` in-process.  On a multi-host pod, run this same
+command on every host under the JAX multi-controller runtime
+(``jax.distributed.initialize`` is called automatically when the standard TPU
+pod environment variables are present); each host sees the global mesh.
+
+Examples::
+
+    tmlauncher --rule BSP --devices 8 \
+        --modelfile theanompi_tpu.models.resnet50 --modelclass ResNet50 \
+        --set batch_size=64 --set n_epochs=90 \
+        --rule-set exch_strategy=psum_bf16 --record-dir ./record
+
+    tmlauncher --rule EASGD --devices all --rule-set tau=8 \
+        --checkpoint-dir ./ckpt --resume
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+
+
+def _parse_kv(pairs: list[str]) -> dict:
+    """k=v pairs with Python-literal values (`lr=0.1`, `lrn=False`,
+    `stage_blocks=(3,4,6,3)`); bare strings stay strings."""
+    out = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise SystemExit(f"expected key=value, got {pair!r}")
+        k, v = pair.split("=", 1)
+        try:
+            out[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            out[k] = v
+    return out
+
+
+def _maybe_init_distributed() -> None:
+    """Join the JAX multi-controller runtime on a pod (no-op on one host)."""
+    if os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    ):
+        import jax
+
+        try:
+            jax.distributed.initialize()
+        except (RuntimeError, ValueError) as e:  # already initialized / local
+            print(f"tmlauncher: distributed init skipped: {e}", file=sys.stderr)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tmlauncher",
+        description="Launch a theanompi_tpu training session on the local "
+        "mesh (run on every host of a pod for multi-host).",
+    )
+    p.add_argument("--rule", default="BSP", choices=["BSP", "EASGD", "GOSGD"])
+    p.add_argument("--devices", default="all",
+                   help="worker count or 'all' (default)")
+    p.add_argument("--modelfile", default="theanompi_tpu.models.wide_resnet")
+    p.add_argument("--modelclass", default="WideResNet")
+    p.add_argument("--set", dest="model_set", action="append", default=[],
+                   metavar="K=V", help="model config entry (repeatable)")
+    p.add_argument("--rule-set", dest="rule_set", action="append", default=[],
+                   metavar="K=V", help="rule config entry (repeatable)")
+    p.add_argument("--config-json", default=None,
+                   help="path to a JSON file with {'model': {...}, 'rule': {...}}")
+    p.add_argument("--record-dir", default=None)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--quiet", action="store_true")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    _maybe_init_distributed()
+
+    model_config: dict = {}
+    rule_config: dict = {}
+    if args.config_json:
+        with open(args.config_json) as f:
+            blob = json.load(f)
+        model_config.update(blob.get("model", {}))
+        rule_config.update(blob.get("rule", {}))
+    model_config.update(_parse_kv(args.model_set))
+    rule_config.update(_parse_kv(args.rule_set))
+    rule_config.setdefault("seed", args.seed)
+    if args.record_dir:
+        rule_config["record_dir"] = args.record_dir
+    if args.checkpoint_dir:
+        rule_config["checkpoint_dir"] = args.checkpoint_dir
+    if args.resume:
+        rule_config["resume"] = True
+    if args.quiet:
+        rule_config["verbose"] = False
+
+    import theanompi_tpu
+
+    rule_cls = getattr(theanompi_tpu, args.rule)
+    devices = None if args.devices == "all" else int(args.devices)
+
+    rule = rule_cls(config=rule_config)
+    rule.init(
+        devices=devices,
+        modelfile=args.modelfile,
+        modelclass=args.modelclass,
+        model_config=model_config,
+    )
+    recorder = rule.wait()
+    if not args.quiet:
+        last = {k: v[-1] for k, v in recorder.val_history.items() if v}
+        print(f"tmlauncher: done. final val: {last}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -29,7 +29,6 @@ from theanompi_tpu.parallel.trainer import (
     make_local_step,
 )
 from theanompi_tpu.utils.helper_funcs import replicate
-from theanompi_tpu.utils.recorder import Recorder
 
 
 class BSPTrainer(BaseTrainer):
@@ -40,15 +39,8 @@ class BSPTrainer(BaseTrainer):
     pure functions.
     """
 
-    def __init__(
-        self,
-        model,
-        mesh=None,
-        exch_strategy: str = "psum",
-        recorder: Recorder | None = None,
-        seed: int = 0,
-    ):
-        super().__init__(model, mesh=mesh, recorder=recorder, seed=seed)
+    def __init__(self, model, mesh=None, exch_strategy: str = "psum", **kwargs):
+        super().__init__(model, mesh=mesh, **kwargs)
         self.exchanger = Exchanger(strategy=exch_strategy)
 
     # -- compilation ---------------------------------------------------------
@@ -98,6 +90,5 @@ class BSP(Rule):
             model,
             mesh=mesh,
             exch_strategy=self.config.get("exch_strategy", "psum"),
-            recorder=recorder,
-            seed=self.config.get("seed", 0),
+            **self.common_trainer_kwargs(recorder),
         )

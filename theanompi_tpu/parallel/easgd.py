@@ -44,7 +44,6 @@ from theanompi_tpu.parallel.trainer import (
     unstack,
 )
 from theanompi_tpu.utils.helper_funcs import replicate
-from theanompi_tpu.utils.recorder import Recorder
 
 
 def elastic_exchange(params, center, alpha, axis_name=DATA_AXIS):
@@ -72,9 +71,9 @@ class EASGDTrainer(BaseTrainer):
     default moving rate divided across the synchronous round.
     """
 
-    def __init__(self, model, mesh=None, recorder: Recorder | None = None,
-                 seed: int = 0, tau: int = 4, alpha: float | None = None):
-        super().__init__(model, mesh=mesh, recorder=recorder, seed=seed)
+    def __init__(self, model, mesh=None, tau: int = 4,
+                 alpha: float | None = None, **kwargs):
+        super().__init__(model, mesh=mesh, **kwargs)
         self.tau = tau
         self.alpha = alpha if alpha is not None else 0.5 / self.n_workers
         self.center = None
@@ -136,6 +135,9 @@ class EASGDTrainer(BaseTrainer):
         """Validate with the center parameters (the reference server's job)."""
         return self.center, self._consensus_state_fn(self.state)
 
+    def checkpoint_trees(self) -> dict:
+        return {**super().checkpoint_trees(), "center": self.center}
+
 
 class EASGD(Rule):
     """Elastic-averaging rule.  Config: ``tau``, ``alpha``, ``scale_lr``."""
@@ -147,8 +149,7 @@ class EASGD(Rule):
         return EASGDTrainer(
             model,
             mesh=mesh,
-            recorder=recorder,
-            seed=self.config.get("seed", 0),
             tau=self.config.get("tau", 4),
             alpha=self.config.get("alpha"),
+            **self.common_trainer_kwargs(recorder),
         )

@@ -38,7 +38,6 @@ from theanompi_tpu.parallel.trainer import (
     stack_for_workers,
     unstack,
 )
-from theanompi_tpu.utils.recorder import Recorder
 
 
 def gossip_merge(params, weight, push, shift, n, axis_name=DATA_AXIS):
@@ -89,14 +88,13 @@ class GOSGDTrainer(BaseTrainer):
     semantics; 1/n keeps expected traffic at one push per round).
     """
 
-    def __init__(self, model, mesh=None, recorder: Recorder | None = None,
-                 seed: int = 0, p_push: float | None = None):
-        super().__init__(model, mesh=mesh, recorder=recorder, seed=seed)
+    def __init__(self, model, mesh=None, p_push: float | None = None, **kwargs):
+        super().__init__(model, mesh=mesh, **kwargs)
         self.p_push = p_push if p_push is not None else 1.0 / max(self.n_workers, 2)
         self.weights = None
         self._gossip_fn = None
         self._consensus_fn = None
-        self._host_rng = np.random.RandomState(seed + 17)
+        self._host_rng = np.random.RandomState(self.seed + 17)
 
     def compile_iter_fns(self) -> None:
         local_step = make_local_step(
@@ -182,6 +180,9 @@ class GOSGDTrainer(BaseTrainer):
         """Validate with the weighted consensus of all workers."""
         return self._consensus_fn(self.params, self.weights, self.state)
 
+    def checkpoint_trees(self) -> dict:
+        return {**super().checkpoint_trees(), "weights": self.weights}
+
 
 class GOSGD(Rule):
     """Gossip rule.  Config: ``p_push``."""
@@ -190,7 +191,6 @@ class GOSGD(Rule):
         return GOSGDTrainer(
             model,
             mesh=mesh,
-            recorder=recorder,
-            seed=self.config.get("seed", 0),
             p_push=self.config.get("p_push"),
+            **self.common_trainer_kwargs(recorder),
         )

@@ -135,13 +135,19 @@ class BaseTrainer:
     """
 
     def __init__(self, model, mesh=None, recorder: Recorder | None = None,
-                 seed: int = 0, prefetch_depth: int = 2):
+                 seed: int = 0, prefetch_depth: int = 2,
+                 checkpoint_dir: str | None = None, checkpoint_keep: int = 3):
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh(n_data=1)
         self.n_workers = self.mesh.shape[DATA_AXIS]
         self.recorder = recorder or Recorder()
         self.seed = seed
         self.prefetch_depth = prefetch_depth
+        self.checkpointer = None
+        if checkpoint_dir:
+            from theanompi_tpu.utils.checkpoint import Checkpointer
+
+            self.checkpointer = Checkpointer(checkpoint_dir, keep=checkpoint_keep)
         self.optimizer = model.build_optimizer()
         self.global_batch = model.batch_size * self.n_workers
         self._step_fn = None
@@ -165,6 +171,40 @@ class BaseTrainer:
 
     def post_step(self) -> None:
         """Periodic host-driven exchange hook (EASGD/GOSGD)."""
+
+    def checkpoint_trees(self) -> dict:
+        """Named pytrees a checkpoint must capture (rules add extras)."""
+        return {
+            "params": self.params,
+            "state": self.state,
+            "opt_state": self.opt_state,
+        }
+
+    def save_checkpoint(self, epoch: int) -> None:
+        if self.checkpointer is not None:
+            self.checkpointer.save(epoch, self.iteration, self.checkpoint_trees())
+            self.recorder.save(self.checkpointer.directory)
+
+    def try_resume(self) -> bool:
+        """Restore the latest checkpoint if one exists; -> resumed or not.
+
+        Call after ``init_state`` (the fresh state is the restore template,
+        carrying pytree structure and shardings)."""
+        if self.checkpointer is None:
+            return False
+        epoch = self.checkpointer.latest_epoch()
+        if epoch is None:
+            return False
+        restored = self.checkpointer.load(epoch, self.checkpoint_trees())
+        for name, tree in restored.items():
+            setattr(self, name, tree)  # params/state/opt_state + rule extras
+        self.epoch = epoch + 1  # that epoch completed
+        self.iteration = self.checkpointer.latest_iteration()
+        self.recorder.load(self.checkpointer.directory)
+        if self.recorder.verbose:
+            print(f"resumed from epoch {epoch} "
+                  f"(iteration {self.iteration})", flush=True)
+        return True
 
     # -- iteration (reference train_iter/val_iter) ---------------------------
     def train_iter(self, batch: dict, lr: float, recorder: Recorder | None = None):
@@ -253,6 +293,7 @@ class BaseTrainer:
                 if close is not None:
                     close()
             self.validate(epoch)
+            self.save_checkpoint(epoch)
             self.epoch = epoch + 1  # resume point: next epoch, not this one
         self.recorder.save()
         model.cleanup()
@@ -281,6 +322,16 @@ class Rule:
 
     def make_trainer(self, model, mesh, recorder) -> BaseTrainer:
         raise NotImplementedError
+
+    def common_trainer_kwargs(self, recorder) -> dict:
+        """Base-trainer kwargs every rule forwards."""
+        return dict(
+            recorder=recorder,
+            seed=self.config.get("seed", 0),
+            prefetch_depth=self.config.get("prefetch", 2),
+            checkpoint_dir=self.config.get("checkpoint_dir"),
+            checkpoint_keep=self.config.get("checkpoint_keep", 3),
+        )
 
     def adjust_model_config(self, model_config: dict, n_workers: int) -> None:
         """Rule-specific model-config defaults (e.g. sync-BN for BSP)."""
@@ -311,6 +362,8 @@ class Rule:
         self.trainer = self.make_trainer(model, mesh, recorder)
         self.trainer.compile_iter_fns()
         self.trainer.init_state()
+        if self.config.get("resume"):
+            self.trainer.try_resume()
         return self
 
     def wait(self):

@@ -1,0 +1,133 @@
+"""Checkpoint/resume: per-epoch pytree snapshots + recorder histories.
+
+Reference (unverified — SURVEY.md §5): rank-0 (or the EASGD server) saved
+``params`` as ``.npy`` per epoch via ``Weight.save()``/helper save; resume
+loaded a configured epoch's weights and the Recorder histories.
+
+Here the whole train state (params/state/opt_state plus rule extras like the
+EASGD center or GOSGD weights) is flattened by key path into one ``.npz``
+per epoch, with a ``latest`` pointer and bounded retention.  Restore needs a
+template (the freshly initialized state) so pytree structure and shardings
+come from the trainer, not the file — arrays are placed back with each
+template leaf's sharding, making checkpoints portable across mesh shapes as
+long as the logical state matches.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+
+
+def _to_host(leaf) -> np.ndarray:
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        # multi-host pod: this host holds only its shards; gather the global
+        # value (a collective — every process must reach this point)
+        from jax.experimental import multihost_utils
+
+        leaf = multihost_utils.process_allgather(leaf, tiled=True)
+    return np.asarray(leaf)
+
+
+def _flatten(tree) -> dict[str, np.ndarray]:
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        out[key] = _to_host(leaf)
+    return out
+
+
+def _restore_into(template, arrays: dict[str, np.ndarray]):
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    new_leaves = []
+    for path, leaf in leaves:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        if key not in arrays:
+            raise KeyError(f"checkpoint missing leaf {key!r}")
+        arr = arrays[key]
+        if hasattr(leaf, "shape") and tuple(arr.shape) != tuple(leaf.shape):
+            raise ValueError(
+                f"checkpoint leaf {key!r} shape {arr.shape} != "
+                f"expected {tuple(leaf.shape)}"
+            )
+        if isinstance(leaf, jax.Array):
+            arr = jax.device_put(arr.astype(leaf.dtype), leaf.sharding)
+        new_leaves.append(arr)
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(template), new_leaves
+    )
+
+
+class Checkpointer:
+    """Directory of ``ckpt_eNNNN.npz`` files + ``latest.json`` pointer."""
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, epoch: int) -> str:
+        return os.path.join(self.directory, f"ckpt_e{epoch:04d}.npz")
+
+    def save(self, epoch: int, iteration: int, trees: dict) -> str:
+        """``trees``: name -> pytree (params/state/opt_state/extras).
+
+        On a multi-host pod every process must call this (the host-gather of
+        cross-host-sharded leaves is a collective); only process 0 writes.
+        """
+        flat: dict[str, np.ndarray] = {}
+        for name, tree in trees.items():
+            for k, v in _flatten(tree).items():
+                flat[f"{name}::{k}"] = v
+        path = self._path(epoch)
+        if jax.process_index() != 0:
+            return path
+        np.savez(path + ".tmp.npz", **flat)
+        os.replace(path + ".tmp.npz", path)  # atomic publish
+        latest = os.path.join(self.directory, "latest.json")
+        with open(latest + ".tmp", "w") as f:
+            json.dump({"epoch": epoch, "iteration": iteration}, f)
+        os.replace(latest + ".tmp", latest)  # a crash must not truncate it
+        self._prune()
+        return path
+
+    def _prune(self) -> None:
+        ckpts = sorted(
+            f for f in os.listdir(self.directory)
+            if f.startswith("ckpt_e") and f.endswith(".npz")
+        )
+        for f in ckpts[: max(0, len(ckpts) - self.keep)]:
+            os.remove(os.path.join(self.directory, f))
+
+    def latest_epoch(self) -> int | None:
+        p = os.path.join(self.directory, "latest.json")
+        if not os.path.exists(p):
+            return None
+        with open(p) as f:
+            meta = json.load(f)
+        return meta["epoch"] if os.path.exists(self._path(meta["epoch"])) else None
+
+    def latest_iteration(self) -> int:
+        p = os.path.join(self.directory, "latest.json")
+        if not os.path.exists(p):
+            return 0
+        with open(p) as f:
+            return json.load(f).get("iteration", 0)
+
+    def load(self, epoch: int, templates: dict) -> dict:
+        """Restore each named pytree into the matching template's structure
+        and shardings."""
+        with np.load(self._path(epoch)) as z:
+            arrays = {k: z[k] for k in z.files}
+        out = {}
+        for name, template in templates.items():
+            sub = {
+                k.split("::", 1)[1]: v
+                for k, v in arrays.items()
+                if k.startswith(f"{name}::")
+            }
+            out[name] = _restore_into(template, sub)
+        return out
