@@ -25,10 +25,11 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from theanompi_tpu.ops import SGD, softmax_cross_entropy, top_k_error
 from theanompi_tpu.ops.layers import Layer
-from theanompi_tpu.parallel.mesh import BF16, FP32, Precision
+from theanompi_tpu.parallel.mesh import BF16, FP32, DATA_AXIS, Precision
 
 
 class Model:
@@ -65,6 +66,30 @@ class Model:
     def init_opt_state(self, optimizer, params):
         """Optimizer-state layout; GANs override to split per network."""
         return optimizer.init(params)
+
+    # -- sharding hooks (defaults = pure data parallelism) -------------------
+    def param_specs(self, params):
+        """PartitionSpec per param leaf (tensor-parallel models override
+        with :func:`theanompi_tpu.parallel.tensor.specs_from_rules`)."""
+        return jax.tree.map(lambda _: P(), params)
+
+    def state_specs(self, state):
+        return jax.tree.map(lambda _: P(), state)
+
+    def opt_state_specs(self, optimizer, param_specs):
+        """Mirrors ``init_opt_state``; GANs override to split per network."""
+        return optimizer.init_specs(param_specs)
+
+    def batch_partition(self) -> P:
+        """Leading-dims spec for batches (truncated per leaf rank).
+        Sequence-parallel models return ``P("data", "seq")``."""
+        return P(DATA_AXIS)
+
+    def grad_reduce_axes(self) -> tuple[str, ...]:
+        """Mesh axes gradients are mean-reduced over (the BSP exchange).
+        Sequence-parallel models add ``"seq"`` — each seq shard computes a
+        partial gradient of the token-mean loss."""
+        return (DATA_AXIS,)
 
     # -- pure functions the trainer compiles --------------------------------
     def init_params(self, rng):

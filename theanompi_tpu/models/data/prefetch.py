@@ -33,7 +33,7 @@ class Prefetcher:
     An exception in the source iterator is re-raised at the consuming site.
     """
 
-    def __init__(self, it, mesh=None, depth: int = 2):
+    def __init__(self, it, mesh=None, depth: int = 2, spec=None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self._q: queue.Queue = queue.Queue(maxsize=depth)
@@ -56,7 +56,7 @@ class Prefetcher:
                     if self._stop.is_set():
                         return
                     if mesh is not None:
-                        item = shard_batch(mesh, item)
+                        item = shard_batch(mesh, item, spec=spec)
                     if not put(item):
                         return
             except BaseException as e:  # propagate to consumer
@@ -95,9 +95,9 @@ class Prefetcher:
         self._thread.join(timeout=5)
 
 
-def prefetch(it, mesh=None, depth: int = 2):
+def prefetch(it, mesh=None, depth: int = 2, spec=None):
     """``depth=0`` disables prefetching (pass-through), else wraps in a
     :class:`Prefetcher`."""
     if depth == 0:
         return it
-    return Prefetcher(it, mesh=mesh, depth=depth)
+    return Prefetcher(it, mesh=mesh, depth=depth, spec=spec)

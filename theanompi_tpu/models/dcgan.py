@@ -106,6 +106,12 @@ class DCGAN(Model):
             "disc": optimizer.init(params["disc"]),
         }
 
+    def opt_state_specs(self, optimizer, param_specs):
+        return {
+            "gen": optimizer.init_specs(param_specs["gen"]),
+            "disc": optimizer.init_specs(param_specs["disc"]),
+        }
+
     def init_params(self, rng):
         kg, kd = jax.random.split(rng)
         cfg = self.config

@@ -1,0 +1,143 @@
+"""Decoder-only transformer LM with dp × tp × sp sharding.
+
+Beyond-reference extension (SURVEY.md §5 marks long-context absent upstream)
+that exercises the framework's full parallelism surface: data parallelism
+(the rules), tensor parallelism (Megatron-style column/row splits over the
+``model`` axis — :mod:`theanompi_tpu.parallel.tensor`), and sequence/context
+parallelism (ring attention over the ``seq`` axis —
+:mod:`theanompi_tpu.parallel.ring_attention`), all inside one BSP step.
+
+Config: ``dim``/``heads``/``n_layers``/``seq_len``; ``seq_parallel=True``
+shards batches ``P(data, seq)`` and adds ``seq`` to the gradient reduction.
+Trains on PTB (or the synthetic bigram stream) like the LSTM LM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.models.contract import SupervisedModel
+from theanompi_tpu.models.lstm import PTBData
+from theanompi_tpu.ops import initializers as init_lib
+from theanompi_tpu.ops import layers as L
+from theanompi_tpu.ops.attention import MultiHeadAttention, PositionEmbedding
+from theanompi_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
+from theanompi_tpu.parallel.tensor import (
+    TP_RULES,
+    ColumnParallelDense,
+    RowParallelDense,
+    specs_from_rules,
+)
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class _Block(L.Layer):
+    """Pre-norm transformer block: LN→MHA→res, LN→MLP(4x, gelu)→res."""
+
+    dim: int
+    heads: int
+    dropout: float = 0.0
+
+    def _subs(self):
+        return (
+            ("ln1", L.LayerNorm()),
+            ("attn", MultiHeadAttention(self.dim, self.heads, causal=True)),
+            ("ln2", L.LayerNorm()),
+            ("up", ColumnParallelDense(4 * self.dim, w_init=init_lib.normal(0.02))),
+            ("down", RowParallelDense(self.dim, w_init=init_lib.normal(0.02))),
+        )
+
+    def init(self, key, in_shape):
+        params, state = {}, {}
+        keys = jax.random.split(key, 5)
+        shape = in_shape
+        for (name, layer), k in zip(self._subs(), keys):
+            if name in ("ln1", "ln2", "attn"):
+                p, s, _ = layer.init(k, in_shape)
+            elif name == "up":
+                p, s, up_shape = layer.init(k, in_shape)
+            else:
+                p, s, _ = layer.init(k, up_shape)
+            if p:
+                params[name] = p
+            if s:
+                state[name] = s
+        return params, state, tuple(shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        subs = dict(self._subs())
+        rngs = (
+            jax.random.split(rng, 2) if rng is not None else (None, None)
+        )
+        drop = L.Dropout(self.dropout)
+
+        h, _ = subs["ln1"].apply(params["ln1"], {}, x)
+        h, _ = subs["attn"].apply(params["attn"], {}, h, train=train)
+        h, _ = drop.apply({}, {}, h, train=train, rng=rngs[0])
+        x = x + h
+        h, _ = subs["ln2"].apply(params["ln2"], {}, x)
+        h, _ = subs["up"].apply(params["up"], {}, h)
+        h = jax.nn.gelu(h)
+        h, _ = subs["down"].apply(params["down"], {}, h)
+        h, _ = drop.apply({}, {}, h, train=train, rng=rngs[1])
+        return x + h, state
+
+
+class TransformerLM(SupervisedModel):
+    default_config = {
+        "batch_size": 8,
+        "n_epochs": 10,
+        "lr": 1e-3,
+        "momentum": 0.9,
+        "grad_clip": 1.0,
+        "seq_len": 256,
+        "dim": 256,
+        "heads": 8,
+        "n_layers": 4,
+        "dropout": 0.1,
+        "seq_parallel": False,
+    }
+
+    def build_data(self):
+        return PTBData(self.config)
+
+    def build_net(self):
+        cfg = self.config
+        layers: list[L.Layer] = [
+            L.Embedding(self.data.vocab, cfg["dim"],
+                        w_init=init_lib.normal(0.02)),
+            PositionEmbedding(cfg["seq_len"], cfg["dim"]),
+        ]
+        for _ in range(cfg["n_layers"]):
+            layers.append(_Block(cfg["dim"], cfg["heads"], cfg["dropout"]))
+        layers += [
+            L.LayerNorm(),
+            L.Dense(self.data.vocab, w_init=init_lib.glorot_normal),
+        ]
+        return L.Sequential(layers), (cfg["seq_len"],)
+
+    # -- sharding ------------------------------------------------------------
+    def param_specs(self, params):
+        return specs_from_rules(params, TP_RULES)
+
+    def batch_partition(self) -> P:
+        if self.config["seq_parallel"]:
+            return P(DATA_AXIS, SEQ_AXIS)
+        return P(DATA_AXIS)
+
+    def grad_reduce_axes(self) -> tuple[str, ...]:
+        if self.config["seq_parallel"]:
+            return (DATA_AXIS, SEQ_AXIS)
+        return (DATA_AXIS,)
+
+    def loss_fn(self, params, state, batch, rng, train: bool):
+        loss, (new_state, metrics) = super().loss_fn(
+            params, state, batch, rng, train
+        )
+        metrics = dict(metrics)
+        metrics["perplexity"] = jnp.exp(metrics["cost"])
+        return loss, (new_state, metrics)

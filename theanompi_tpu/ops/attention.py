@@ -1,0 +1,107 @@
+"""Multi-head attention layers: full, flash-blockwise, and ring (seq-parallel).
+
+Beyond-reference capability (the 2016 reference has no attention — SURVEY.md
+§5), built on the same primitives as the exchanger: the ring variant
+circulates KV blocks over the ``seq`` mesh axis with ``ppermute``
+(:mod:`theanompi_tpu.parallel.ring_attention`).  Head projections are
+tensor-parallel-ready: Q/K/V are column-parallel (heads shard over the
+``model`` axis), the output projection is row-parallel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.ops import initializers as init_lib
+from theanompi_tpu.ops import layers as L
+from theanompi_tpu.parallel.mesh import SEQ_AXIS
+from theanompi_tpu.parallel.ring_attention import blockwise_attention, ring_attention
+from theanompi_tpu.parallel.tensor import (
+    ColumnParallelDense,
+    RowParallelDense,
+    axis_bound,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiHeadAttention(L.Layer):
+    """Causal/bidirectional MHA over ``[B, T, D]``.
+
+    ``heads`` is the GLOBAL head count; under tensor parallelism each model
+    shard holds ``heads / mesh['model']`` heads (the column-parallel Q/K/V
+    slices are head-aligned because ``D % heads == 0`` weights shard on the
+    feature dim).  When the ``seq`` axis is bound with size > 1, attention
+    runs as a KV ring over the sequence shards.
+    """
+
+    dim: int
+    heads: int
+    causal: bool = True
+
+    def _subs(self):
+        w02 = init_lib.normal(0.02)
+        return (
+            ("q", ColumnParallelDense(self.dim, w_init=w02)),
+            ("k", ColumnParallelDense(self.dim, w_init=w02)),
+            ("v", ColumnParallelDense(self.dim, w_init=w02)),
+            ("o", RowParallelDense(self.dim, w_init=w02)),
+        )
+
+    def init(self, key, in_shape):
+        if in_shape[-1] != self.dim:
+            raise ValueError(f"MHA dim {self.dim} != input {in_shape[-1]}")
+        if self.dim % self.heads:
+            raise ValueError(f"dim {self.dim} not divisible by {self.heads} heads")
+        params = {}
+        keys = jax.random.split(key, 4)
+        for (name, layer), k in zip(self._subs(), keys):
+            p, _, _ = layer.init(k, in_shape)
+            params[name] = p
+        return params, {}, tuple(in_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        subs = dict(self._subs())
+        b, t, _ = x.shape
+        head_dim = self.dim // self.heads
+        q, _ = subs["q"].apply(params["q"], {}, x)
+        k, _ = subs["k"].apply(params["k"], {}, x)
+        v, _ = subs["v"].apply(params["v"], {}, x)
+        # local head count falls out of the (possibly sharded) width
+        h_local = q.shape[-1] // head_dim
+        q = q.reshape(b, t, h_local, head_dim)
+        k = k.reshape(b, t, h_local, head_dim)
+        v = v.reshape(b, t, h_local, head_dim)
+        if axis_bound(SEQ_AXIS) and jax.lax.axis_size(SEQ_AXIS) > 1:
+            out = ring_attention(q, k, v, causal=self.causal)
+        else:
+            out = blockwise_attention(q, k, v, causal=self.causal)
+        out = out.reshape(b, t, h_local * head_dim)
+        y, _ = subs["o"].apply(params["o"], {}, out)
+        return y, state
+
+
+@dataclasses.dataclass(frozen=True)
+class PositionEmbedding(L.Layer):
+    """Learned absolute positions, offset-aware under sequence sharding."""
+
+    max_len: int
+    dim: int
+
+    def init(self, key, in_shape):
+        t = in_shape[0]
+        if t > self.max_len:
+            raise ValueError(f"seq len {t} > max_len {self.max_len}")
+        params = {"pos": init_lib.normal(0.02)(key, (self.max_len, self.dim))}
+        return params, {}, tuple(in_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        t = x.shape[1]
+        start = 0
+        if axis_bound(SEQ_AXIS):
+            # global position of this shard's first token
+            start = jax.lax.axis_index(SEQ_AXIS) * t
+        pos = jax.lax.dynamic_slice_in_dim(params["pos"], start, t).astype(x.dtype)
+        return x + pos[None], state

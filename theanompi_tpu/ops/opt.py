@@ -40,6 +40,11 @@ class Optimizer:
     def init(self, params):
         raise NotImplementedError
 
+    def init_specs(self, param_specs):
+        """PartitionSpecs mirroring ``init``'s structure (momenta shard like
+        their params; counters replicate)."""
+        raise NotImplementedError
+
     def update(self, grads, opt_state, params, lr):
         raise NotImplementedError
 
@@ -68,6 +73,11 @@ class SGD(Optimizer):
         if self.momentum == 0.0:
             return {}
         return {"velocity": _tmap(jnp.zeros_like, params)}
+
+    def init_specs(self, param_specs):
+        if self.momentum == 0.0:
+            return {}
+        return {"velocity": param_specs}
 
     def update(self, grads, opt_state, params, lr):
         grads = self._preprocess(grads, params)
@@ -102,6 +112,11 @@ class Adam(Optimizer):
             "t": jnp.zeros((), jnp.int32),
         }
 
+    def init_specs(self, param_specs):
+        from jax.sharding import PartitionSpec as P
+
+        return {"m": param_specs, "v": param_specs, "t": P()}
+
     def update(self, grads, opt_state, params, lr):
         grads = self._preprocess(grads, params)
         t = opt_state["t"] + 1
@@ -130,6 +145,9 @@ class RMSProp(Optimizer):
 
     def init(self, params):
         return {"sq": _tmap(jnp.zeros_like, params)}
+
+    def init_specs(self, param_specs):
+        return {"sq": param_specs}
 
     def update(self, grads, opt_state, params, lr):
         grads = self._preprocess(grads, params)

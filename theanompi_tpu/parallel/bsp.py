@@ -28,7 +28,7 @@ from theanompi_tpu.parallel.trainer import (
     make_local_eval,
     make_local_step,
 )
-from theanompi_tpu.utils.helper_funcs import replicate
+from theanompi_tpu.utils.helper_funcs import place
 
 
 class BSPTrainer(BaseTrainer):
@@ -41,7 +41,24 @@ class BSPTrainer(BaseTrainer):
 
     def __init__(self, model, mesh=None, exch_strategy: str = "psum", **kwargs):
         super().__init__(model, mesh=mesh, **kwargs)
-        self.exchanger = Exchanger(strategy=exch_strategy)
+        # reduce over every axis the batch is sharded on (data; +seq for
+        # sequence-parallel models whose grads are per-shard partials)
+        self.exchanger = Exchanger(
+            strategy=exch_strategy, axis_name=model.grad_reduce_axes()
+        )
+        self.batch_spec = model.batch_partition()
+
+    def _spec_trees(self):
+        """(param_specs, state_specs, opt_specs) from the model's hooks,
+        computed shape-only (no device work)."""
+        shapes = jax.eval_shape(
+            self.model.init_params, jax.random.PRNGKey(self.seed + 1)
+        )
+        param_t, state_t = shapes
+        pspecs = self.model.param_specs(param_t)
+        sspecs = self.model.state_specs(state_t)
+        ospecs = self.model.opt_state_specs(self.optimizer, pspecs)
+        return pspecs, sspecs, ospecs
 
     # -- compilation ---------------------------------------------------------
     def compile_iter_fns(self) -> None:
@@ -50,14 +67,15 @@ class BSPTrainer(BaseTrainer):
             self.model, self.optimizer, jax.random.PRNGKey(self.seed),
             exchanger=self.exchanger,
         )
-        local_eval = make_local_eval(self.model)
+        local_eval = make_local_eval(self.model, axes=self.exchanger.axis_name)
+        pspecs, sspecs, ospecs = self._spec_trees()
 
         self._step_fn = jax.jit(
             shard_map(
                 local_step,
                 self.mesh,
-                in_specs=(P(), P(), P(), P(DATA_AXIS), P(), P()),
-                out_specs=(P(), P(), P(), P()),
+                in_specs=(pspecs, sspecs, ospecs, self.batch_spec, P(), P()),
+                out_specs=(pspecs, sspecs, ospecs, P()),
             ),
             donate_argnums=(0, 1, 2),
         )
@@ -65,16 +83,19 @@ class BSPTrainer(BaseTrainer):
             shard_map(
                 local_eval,
                 self.mesh,
-                in_specs=(P(), P(), P(DATA_AXIS)),
+                in_specs=(pspecs, sspecs, self.batch_spec),
                 out_specs=P(),
             )
         )
 
     def init_state(self) -> None:
         params, state = self.model.init_params(jax.random.PRNGKey(self.seed + 1))
-        self.params = replicate(self.mesh, params)
-        self.state = replicate(self.mesh, state)
-        self.opt_state = replicate(self.mesh, self.model.init_opt_state(self.optimizer, params))
+        pspecs, sspecs, ospecs = self._spec_trees()
+        self.params = place(self.mesh, params, pspecs)
+        self.state = place(self.mesh, state, sspecs)
+        self.opt_state = place(
+            self.mesh, self.model.init_opt_state(self.optimizer, params), ospecs
+        )
 
 
 class BSP(Rule):

@@ -137,30 +137,49 @@ class Exchanger:
     disagree with the actual mesh.
     """
 
-    def __init__(self, strategy: str = "psum", axis_name: str = DATA_AXIS):
+    def __init__(self, strategy: str = "psum",
+                 axis_name: str | tuple[str, ...] = DATA_AXIS):
         if strategy not in STRATEGIES:
             raise ValueError(
                 f"unknown exchange strategy {strategy!r}; "
                 f"available: {sorted(STRATEGIES)}"
             )
+        if isinstance(axis_name, (tuple, list)) and len(axis_name) > 1:
+            if strategy not in ("psum", "psum_bf16"):
+                raise ValueError(
+                    f"strategy {strategy!r} reduces over a single ring; "
+                    f"multi-axis exchange ({axis_name}) needs 'psum'/'psum_bf16'"
+                )
+            axis_name = tuple(axis_name)
+        elif isinstance(axis_name, (tuple, list)):
+            axis_name = axis_name[0]
         self.strategy = strategy
         self.axis_name = axis_name
         self._fn = STRATEGIES[strategy]
 
     def exchange(self, tree):
-        """Mean-reduce every floating leaf across the data axis.
+        """Mean-reduce every floating leaf across the exchange axes.
 
-        Call inside ``shard_map`` over a mesh that binds ``axis_name``.
+        Call inside ``shard_map`` over a mesh that binds ``axis_name``
+        (a single axis, or a tuple — e.g. ``("data", "seq")`` when gradients
+        carry per-sequence-shard partial contributions too).
         Non-float leaves (step counters and other bookkeeping that may ride
         along in an optimizer-state pytree) pass through unchanged —
         mean-reducing them would silently promote ints to floats.
         """
+        axes = (
+            self.axis_name
+            if isinstance(self.axis_name, tuple)
+            else (self.axis_name,)
+        )
         try:
-            n = lax.axis_size(self.axis_name)
+            n = 1
+            for a in axes:
+                n *= lax.axis_size(a)
         except NameError as e:
             raise ValueError(
                 f"Exchanger.exchange must run inside shard_map over a mesh "
-                f"binding axis {self.axis_name!r}"
+                f"binding axes {axes!r}"
             ) from e
         if n == 1:
             return tree

@@ -154,11 +154,16 @@ def shard_map(f, mesh: Mesh, in_specs, out_specs, check: bool = False):
     )
 
 
-def replica_rng(key: jax.Array, axis_name: str = DATA_AXIS) -> jax.Array:
-    """Derive a distinct PRNG key per replica along ``axis_name``.
+def replica_rng(key: jax.Array, axis_name=DATA_AXIS) -> jax.Array:
+    """Derive a distinct PRNG key per replica along one or more mesh axes.
 
     Call only inside ``shard_map``/collective context.  Replaces the
     reference's per-process numpy seeding (each MPI rank seeded separately;
     SURVEY.md §2.1 base.py) with a deterministic fold of the replica index.
+    Pass a tuple (e.g. ``("data", "seq")``) when activations are sharded over
+    several axes and per-shard randomness (dropout) must differ on each.
     """
-    return jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    for a in axes:
+        key = jax.random.fold_in(key, jax.lax.axis_index(a))
+    return key

@@ -1,0 +1,123 @@
+"""Ring attention: sequence/context parallelism over the ``seq`` mesh axis.
+
+Beyond the reference's capability set (a 2016 framework has no attention at
+all — SURVEY.md §5 "long-context: absent"), but first-class here: long-context
+training is part of this framework's scale contract, and the communication
+shape is exactly the exchanger's ring (``theanompi_tpu.parallel.exchanger``)
+applied to keys/values instead of gradients.
+
+Mechanism (Liu et al. 2023, "Ring Attention with Blockwise Transformers"):
+shard the sequence over the ``seq`` axis; each device keeps its Q block
+resident and circulates KV blocks around the ICI ring with ``ppermute``,
+accumulating attention with an online (flash-style) softmax, so the full
+S×S score matrix never materializes and per-device memory is O(S/n · d).
+Causal masking uses global block offsets: whole KV-future blocks are skipped
+numerically (their contribution is masked), intra-block masking applies on
+the diagonal block.
+
+All functions are pure and run inside ``shard_map``; XLA overlaps each
+ppermute hop with the current block's compute where dependencies allow.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from theanompi_tpu.parallel.mesh import SEQ_AXIS
+
+_NEG_INF = -1e30  # fp32-safe mask value (finite: avoids NaN from inf-inf)
+
+
+def _block_attend(q, k, v, m_prev, l_prev, acc, mask=None):
+    """One online-softmax accumulation step.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; running max ``m_prev`` [B, H, Tq],
+    normalizer ``l_prev`` [B, H, Tq], accumulator ``acc`` [B, Tq, H, D].
+    """
+    scale = q.shape[-1] ** -0.5
+    # scores: [B, H, Tq, Tk]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    # renormalize previous accumulation to the new max
+    correction = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[..., None])  # [B, H, Tq, Tk]
+    l_new = l_prev * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    acc_new = acc * correction.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, acc_new
+
+
+def blockwise_attention(q, k, v, causal: bool = False, block_size: int | None = None):
+    """Single-device flash-style attention (the ring's n=1 case / reference
+    implementation for tests).  [B, T, H, D] layout."""
+    b, t, h, d = q.shape
+    if block_size is None or block_size >= k.shape[1]:
+        blocks = [(0, k.shape[1])]
+    else:
+        blocks = [
+            (i, min(i + block_size, k.shape[1]))
+            for i in range(0, k.shape[1], block_size)
+        ]
+    qf = q.astype(jnp.float32)
+    m = jnp.full((b, h, t), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, t), jnp.float32)
+    acc = jnp.zeros((b, t, h, d), jnp.float32)
+    q_pos = jnp.arange(t)
+    for start, stop in blocks:
+        kb = k[:, start:stop].astype(jnp.float32)
+        vb = v[:, start:stop]
+        mask = None
+        if causal:
+            mask = q_pos[:, None] >= jnp.arange(start, stop)[None, :]
+            mask = mask[None, None]
+        m, l, acc = _block_attend(qf, kb, vb, m, l, acc, mask)
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, causal: bool = False, axis_name: str = SEQ_AXIS):
+    """Sequence-parallel attention inside ``shard_map`` over ``axis_name``.
+
+    q/k/v: the LOCAL sequence shard, [B, T_local, H, D].  Equivalent to full
+    attention over the gathered sequence (see tests), with KV circulating the
+    ring instead of being gathered.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return blockwise_attention(q, k, v, causal=causal)
+    me = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+
+    qf = q.astype(jnp.float32)
+    m = jnp.full((b, h, t), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, t), jnp.float32)
+    acc = jnp.zeros((b, t, h, d), jnp.float32)
+    ring = [(i, (i + 1) % n) for i in range(n)]
+    q_pos = me * t + jnp.arange(t)
+
+    kv = (k, v)
+    for hop in range(n):
+        # after `hop` forwards along the ring, we hold the block that
+        # originated at (me - hop) mod n
+        src = (me - hop) % n
+        kb, vb = kv
+        mask = None
+        if causal:
+            k_pos = src * t + jnp.arange(t)
+            mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+        m, l, acc = _block_attend(
+            qf, kb.astype(jnp.float32), vb, m, l, acc, mask
+        )
+        if hop < n - 1:
+            kv = jax.tree.map(lambda x: lax.ppermute(x, axis_name, ring), kv)
+
+    # fully-masked rows (can't happen with causal self-attention since the
+    # diagonal is always visible, but guard the division anyway)
+    l_safe = jnp.maximum(l, 1e-30)
+    out = acc / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)

@@ -1,0 +1,127 @@
+"""Tensor parallelism: Megatron-style partitioned layers + partition rules.
+
+Beyond the reference's capability set (SURVEY.md §2.4: data parallelism only)
+but first-class here: the ``model`` mesh axis exists from day one so TP
+composes with the rules.  The scheme is the standard pair:
+
+- **column-parallel**: weight ``[D, F]`` sharded on F — no communication in
+  the forward; outputs (and bias) are feature-sharded;
+- **row-parallel**: weight ``[F, D]`` sharded on F — consumes feature-sharded
+  inputs, produces partial sums, one ``psum`` over ``model`` completes the
+  matmul (bias added after, once).
+
+Layers run inside the rule's ``shard_map``; the *same* layer code runs
+unsharded too (plain jit, tests) because ``maybe_psum`` degrades to identity
+when the axis is absent.  Parameter placement comes from path-regex partition
+rules (the t5x/flax convention) rather than per-layer plumbing.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from theanompi_tpu.ops import layers as L
+from theanompi_tpu.parallel.mesh import MODEL_AXIS
+
+
+def axis_bound(axis_name: str) -> bool:
+    """Is ``axis_name`` bound in the current collective context?"""
+    try:
+        lax.axis_size(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+def maybe_psum(x, axis_name: str = MODEL_AXIS):
+    """psum over ``axis_name`` if bound (shard_map), else identity (plain jit)."""
+    if axis_bound(axis_name):
+        return lax.psum(x, axis_name)
+    return x
+
+
+class ColumnParallelDense(L.Dense):
+    """Feature-sharded Dense: w ``P(None, model)``, b ``P(model)``.
+
+    Forward is communication-free; init sees the GLOBAL width (the host
+    builds full params; the trainer places shards per the partition rules).
+    """
+
+    @property
+    def name(self) -> str:
+        return "cpdense"
+
+
+class RowParallelDense(L.Dense):
+    """Reduction-sharded Dense: w ``P(model, None)``; psum completes the sum.
+
+    The bias is added after the psum (adding before would apply it
+    ``model``-many times).
+    """
+
+    @property
+    def name(self) -> str:
+        return "rpdense"
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        y = x @ params["w"].astype(x.dtype)
+        y = maybe_psum(y, MODEL_AXIS)
+        if self.use_bias:
+            y = y + params["b"].astype(x.dtype)
+        return y, state
+
+
+#: path-regex -> PartitionSpec; first match wins (order matters).
+#: Covers both Sequential-auto-named layers (``03_cpdense/w``) and the
+#: fixed keys composite layers use (attention ``q/k/v/o``, MLP ``up/down``).
+TP_RULES: tuple[tuple[str, P], ...] = (
+    (r".*cpdense.*/w$", P(None, MODEL_AXIS)),
+    (r".*cpdense.*/b$", P(MODEL_AXIS)),
+    (r".*rpdense.*/w$", P(MODEL_AXIS, None)),
+    (r".*/attn/[qkv]/w$", P(None, MODEL_AXIS)),
+    (r".*/attn/[qkv]/b$", P(MODEL_AXIS)),
+    (r".*/attn/o/w$", P(MODEL_AXIS, None)),
+    (r".*/up/w$", P(None, MODEL_AXIS)),
+    (r".*/up/b$", P(MODEL_AXIS)),
+    (r".*/down/w$", P(MODEL_AXIS, None)),
+)
+
+
+def specs_from_rules(params, rules=TP_RULES, default: P = P()):
+    """Map each param leaf's key path against ``rules``; unmatched -> default.
+
+    Paths are ``"/"``-joined dict keys/indices, e.g.
+    ``"net/03_cpdense/w"`` — the same naming ``Sequential.init`` produces.
+    """
+
+    def spec_for(path, leaf):
+        del leaf
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        for pattern, spec in rules:
+            if re.fullmatch(pattern, key):
+                return spec
+        return default
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def check_divisible(params, specs, mesh) -> None:
+    """Fail fast if a rule shards a dim that doesn't divide the axis size."""
+    flat_p = jax.tree_util.tree_leaves_with_path(params)
+    flat_s = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    for (path, leaf), spec in zip(flat_p, flat_s):
+        for dim, axis in enumerate(spec):
+            if axis is None:
+                continue
+            size = mesh.shape[axis]
+            if leaf.shape[dim] % size != 0:
+                key = "/".join(str(getattr(p, "key", p)) for p in path)
+                raise ValueError(
+                    f"param {key!r} dim {dim} ({leaf.shape[dim]}) not "
+                    f"divisible by mesh axis {axis!r} ({size})"
+                )

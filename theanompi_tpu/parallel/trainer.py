@@ -73,7 +73,11 @@ def make_local_step(model, opt, base_key, exchanger=None, stacked=False):
                 params, state, opt_state, batch, lr, step
             )
         else:
-            rng = replica_rng(jax.random.fold_in(base_key, step), DATA_AXIS)
+            # fold every batch-sharding axis (dropout must differ per data
+            # AND seq shard; it must NOT differ across model shards, whose
+            # activations are jointly one logical tensor)
+            axes = exchanger.axis_name if exchanger is not None else DATA_AXIS
+            rng = replica_rng(jax.random.fold_in(base_key, step), axes)
 
             def lossw(p):
                 return model.loss_fn(p, state, batch, rng, train=True)
@@ -91,21 +95,22 @@ def make_local_step(model, opt, base_key, exchanger=None, stacked=False):
                 restack(new_opt_state),
                 jax.tree.map(lambda m: m[None], metrics),
             )
-        metrics = pmean_floats(metrics, DATA_AXIS)
+        axes = exchanger.axis_name if exchanger is not None else DATA_AXIS
+        metrics = pmean_floats(metrics, axes)
         # keep non-learned state consistent across replicas (already
         # identical under sync-BN; pmean repairs drift otherwise)
-        new_state = pmean_floats(new_state, DATA_AXIS)
+        new_state = pmean_floats(new_state, axes)
         return new_params, new_state, new_opt_state, metrics
 
     return local_step
 
 
-def make_local_eval(model):
-    """Shared eval step: replicated params, data-sharded batch."""
+def make_local_eval(model, axes=DATA_AXIS):
+    """Shared eval step: params per their specs, batch per its partition."""
 
     def local_eval(params, state, batch):
         _, (_, metrics) = model.loss_fn(params, state, batch, None, train=False)
-        return pmean_floats(metrics, DATA_AXIS)
+        return pmean_floats(metrics, axes)
 
     return local_eval
 
@@ -143,6 +148,7 @@ class BaseTrainer:
         self.recorder = recorder or Recorder()
         self.seed = seed
         self.prefetch_depth = prefetch_depth
+        self.batch_spec = model.batch_partition()
         self.checkpointer = None
         if checkpoint_dir:
             from theanompi_tpu.utils.checkpoint import Checkpointer
@@ -211,7 +217,7 @@ class BaseTrainer:
         r = recorder or self.recorder
         r.start("wait")
         # already-placed batches (prefetch path) pass through device_put free
-        batch = shard_batch(self.mesh, batch)
+        batch = shard_batch(self.mesh, batch, spec=self.batch_spec)
         r.end("wait")
         r.start("calc")
         self.params, self.state, self.opt_state, metrics = self._step_fn(
@@ -235,7 +241,7 @@ class BaseTrainer:
 
     def val_iter(self, batch: dict, recorder: Recorder | None = None,
                  eval_args=None):
-        batch = shard_batch(self.mesh, batch)
+        batch = shard_batch(self.mesh, batch, spec=self.batch_spec)
         # eval_args may be expensive (GOSGD consensus psums the whole param
         # tree) — validate() hoists it out of the per-batch loop
         params, state = eval_args if eval_args is not None else self.eval_args()
@@ -282,6 +288,7 @@ class BaseTrainer:
                 model.data.train_batches(self.global_batch, epoch, seed=self.seed),
                 mesh=self.mesh,
                 depth=self.prefetch_depth,
+                spec=self.batch_spec,
             )
             try:
                 for batch in batches:
@@ -343,12 +350,21 @@ class Rule:
         modelclass: str = "WideResNet",
         model_config: dict | None = None,
     ):
+        n_model = self.config.get("n_model", 1)
+        n_seq = self.config.get("n_seq", 1)
         if isinstance(devices, int):
-            mesh = make_mesh(n_data=devices, devices=jax.devices()[:devices])
+            # `devices` is the WORKER (data-parallel) count, as in the
+            # reference API; model/seq axes multiply the device need
+            need = devices * n_model * n_seq
+            mesh = make_mesh(n_data=devices, n_model=n_model, n_seq=n_seq,
+                             devices=jax.devices()[:need])
         elif devices is None:
-            mesh = make_mesh()
+            mesh = make_mesh(n_model=n_model, n_seq=n_seq)
         else:
-            mesh = make_mesh(n_data=len(devices), devices=devices)
+            mesh = make_mesh(
+                n_data=len(devices) // (n_model * n_seq),
+                n_model=n_model, n_seq=n_seq, devices=devices,
+            )
         n = mesh.shape[DATA_AXIS]
         model_config = dict(model_config or {})
         self.adjust_model_config(model_config, n)

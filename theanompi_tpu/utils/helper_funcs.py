@@ -34,18 +34,31 @@ def import_model(modelfile: str, modelclass: str):
         ) from e
 
 
-def shard_batch(mesh: Mesh, batch: dict, axis: str = DATA_AXIS) -> dict:
-    """Place a host batch on the mesh, leading dim split over ``axis``."""
+def shard_batch(mesh: Mesh, batch: dict, spec: P | None = None) -> dict:
+    """Place a host batch on the mesh.
+
+    ``spec`` gives the leading-dims partition (``P("data")`` default,
+    ``P("data", "seq")`` for sequence-parallel models); it is truncated to
+    each leaf's rank, remaining dims replicated.
+    """
+    spec = spec if spec is not None else P(DATA_AXIS)
 
     def put(x):
         if not isinstance(x, jax.Array):
             # np.asarray would silently pull an already-placed (prefetched)
             # batch back to host; device_put below is a no-op for those
             x = np.asarray(x)
-        spec = P(axis, *([None] * (x.ndim - 1)))
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        leaf_spec = P(*spec[: x.ndim], *([None] * max(0, x.ndim - len(spec))))
+        return jax.device_put(x, NamedSharding(mesh, leaf_spec))
 
     return jax.tree.map(put, batch)
+
+
+def place(mesh: Mesh, tree, specs):
+    """Place a pytree with a matching pytree of PartitionSpecs."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+    )
 
 
 def replicate(mesh: Mesh, tree):
