@@ -95,46 +95,73 @@ TINY_LM = {"batch_size": 4, "n_train": 64, "n_val": 32, "seq_len": 16,
            "dropout": 0.1, "n_epochs": 1, "precision": "fp32"}
 
 
-def _one_step(mesh, cfg):
+def _run_steps(mesh, cfg, steps=1):
+    """-> (trainer, per-step costs).  Multi-step so the gradient/update path
+    is verified, not just the forward (the step-1 cost is computed from
+    pre-update params and cannot see a wrong gradient)."""
     model = TransformerLM(cfg)
     t = BSPTrainer(model, mesh=mesh)
     t.compile_iter_fns()
     t.init_state()
-    batch = next(iter(model.data.train_batches(t.global_batch, 0, seed=0)))
-    return t, t.train_iter(batch, lr=1e-2)
+    batches = list(model.data.train_batches(t.global_batch, 0, seed=0))
+    costs = [
+        float(t.train_iter(batches[i % len(batches)], lr=1e-2)["cost"])
+        for i in range(steps)
+    ]
+    return t, costs
+
+
+def _replicated_leaf(trainer):
+    """A replicated (non-TP) param leaf: the final LayerNorm scale."""
+    keys = sorted(k for k in trainer.params if "layernorm" in k)
+    return np.asarray(trainer.params[keys[-1]]["scale"])
 
 
 def test_transformer_dp_only():
     mesh = make_mesh(n_data=1, devices=jax.devices()[:1])
-    _, m = _one_step(mesh, dict(TINY_LM))
-    assert np.isfinite(float(m["cost"]))
+    _, costs = _run_steps(mesh, dict(TINY_LM))
+    assert np.isfinite(costs[0])
 
 
 def test_transformer_tp_matches_single_device():
-    """tp=4 must be numerically equivalent to the unsharded model."""
+    """tp=4 must track the unsharded model through 3 train steps.
+
+    Regression test for the replicated-grad bug: without the Megatron f/g
+    operators (parallel/tensor.py) the grads of replicated params (embedding,
+    LayerNorms) are per-shard partials and step 2+ diverges; without the
+    spec-aware global-norm clip (ops/opt.py global_sq_norm) the clip scale is
+    wrong under TP and drifts from the single-device trajectory.
+    """
     cfg = {**TINY_LM, "dropout": 0.0}
     mesh1 = make_mesh(n_data=1, devices=jax.devices()[:1])
-    t1, m1 = _one_step(mesh1, dict(cfg))
+    t1, c1 = _run_steps(mesh1, dict(cfg), steps=3)
 
     mesh_tp = make_mesh(n_data=1, n_model=4, devices=jax.devices()[:4])
-    t2, m2 = _one_step(mesh_tp, dict(cfg))
-    np.testing.assert_allclose(float(m1["cost"]), float(m2["cost"]),
-                               rtol=1e-4)
+    t2, c2 = _run_steps(mesh_tp, dict(cfg), steps=3)
+    np.testing.assert_allclose(c1, c2, rtol=1e-4)
+    # post-update replicated params must match the single-device run (and
+    # implicitly be consistent across shards: a divergent leaf could not
+    # match a single trajectory)
+    np.testing.assert_allclose(
+        _replicated_leaf(t1), _replicated_leaf(t2), rtol=1e-4, atol=1e-6
+    )
     # a TP'd weight is actually distributed over 4 devices
     qw = t2.params["02__block"]["attn"]["q"]["w"]
     assert len(qw.sharding.device_set) == 4
 
 
 def test_transformer_sp_matches_single_device():
-    """seq-parallel (sp=4) must match the unsharded model numerically."""
+    """seq-parallel (sp=4) must track the unsharded model through 3 steps."""
     cfg = {**TINY_LM, "dropout": 0.0}
     mesh1 = make_mesh(n_data=1, devices=jax.devices()[:1])
-    _, m1 = _one_step(mesh1, {**cfg, "seq_parallel": False})
+    t1, c1 = _run_steps(mesh1, {**cfg, "seq_parallel": False}, steps=3)
 
     mesh_sp = make_mesh(n_data=1, n_seq=4, devices=jax.devices()[:4])
-    _, m2 = _one_step(mesh_sp, {**cfg, "seq_parallel": True})
-    np.testing.assert_allclose(float(m1["cost"]), float(m2["cost"]),
-                               rtol=1e-4)
+    t2, c2 = _run_steps(mesh_sp, {**cfg, "seq_parallel": True}, steps=3)
+    np.testing.assert_allclose(c1, c2, rtol=1e-4)
+    np.testing.assert_allclose(
+        _replicated_leaf(t1), _replicated_leaf(t2), rtol=1e-4, atol=1e-6
+    )
 
 
 def test_transformer_dp_tp_sp_combined():

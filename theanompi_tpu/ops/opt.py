@@ -22,12 +22,56 @@ def _tmap(fn, *trees):
     return jax.tree.map(fn, *trees)
 
 
-def clip_by_global_norm(grads, max_norm: float):
+def _spec_mentions(spec, axis: str) -> bool:
+    """Does a PartitionSpec place any dim on ``axis``?"""
+    if spec is None:
+        return False
+    for entry in spec:
+        if entry is None:
+            continue
+        entries = entry if isinstance(entry, tuple) else (entry,)
+        if axis in entries:
+            return True
+    return False
+
+
+def global_sq_norm(grads, param_specs=None):
+    """Global squared L2 norm of a gradient pytree, sharding-aware.
+
+    Under tensor parallelism, leaves whose spec shards a dim over the
+    ``model`` axis hold only that shard's slice; their squared norms must be
+    psummed over the axis to get the true global norm (replicated leaves are
+    identical on every shard and must NOT be).  ``param_specs=None`` (or
+    model axis unbound / size 1) degrades to the plain sum.
+    """
+    from theanompi_tpu.parallel.mesh import MODEL_AXIS
+    from theanompi_tpu.parallel.tensor import axis_bound
+
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if (
+        param_specs is None
+        or not axis_bound(MODEL_AXIS)
+        or jax.lax.axis_size(MODEL_AXIS) == 1
+    ):
+        return sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    spec_leaves = treedef.flatten_up_to(param_specs)
+    repl_sq = jnp.zeros((), jnp.float32)
+    shard_sq = jnp.zeros((), jnp.float32)
+    for g, spec in zip(leaves, spec_leaves):
+        s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        if _spec_mentions(spec, MODEL_AXIS):
+            shard_sq = shard_sq + s
+        else:
+            repl_sq = repl_sq + s
+    return repl_sq + jax.lax.psum(shard_sq, MODEL_AXIS)
+
+
+def clip_by_global_norm(grads, max_norm: float, param_specs=None):
     """Scale the whole gradient pytree so its global L2 norm <= max_norm
-    (the tutorial-era LSTM BPTT stabilizer; reference lstm.py lineage)."""
-    norm = jnp.sqrt(
-        sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree.leaves(grads))
-    )
+    (the tutorial-era LSTM BPTT stabilizer; reference lstm.py lineage).
+    ``param_specs`` makes the norm exact under tensor parallelism
+    (see :func:`global_sq_norm`)."""
+    norm = jnp.sqrt(global_sq_norm(grads, param_specs))
     scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
     return _tmap(lambda g: (g * scale).astype(g.dtype), grads)
 
@@ -45,12 +89,12 @@ class Optimizer:
         their params; counters replicate)."""
         raise NotImplementedError
 
-    def update(self, grads, opt_state, params, lr):
+    def update(self, grads, opt_state, params, lr, param_specs=None):
         raise NotImplementedError
 
-    def _preprocess(self, grads, params):
+    def _preprocess(self, grads, params, param_specs=None):
         if self.grad_clip:
-            grads = clip_by_global_norm(grads, self.grad_clip)
+            grads = clip_by_global_norm(grads, self.grad_clip, param_specs)
         if self.weight_decay:
             grads = _tmap(lambda g, p: g + self.weight_decay * p, grads, params)
         return grads
@@ -79,8 +123,8 @@ class SGD(Optimizer):
             return {}
         return {"velocity": param_specs}
 
-    def update(self, grads, opt_state, params, lr):
-        grads = self._preprocess(grads, params)
+    def update(self, grads, opt_state, params, lr, param_specs=None):
+        grads = self._preprocess(grads, params, param_specs)
         if self.momentum == 0.0:
             new_params = _tmap(lambda p, g: p - lr * g, params, grads)
             return new_params, opt_state
@@ -117,8 +161,8 @@ class Adam(Optimizer):
 
         return {"m": param_specs, "v": param_specs, "t": P()}
 
-    def update(self, grads, opt_state, params, lr):
-        grads = self._preprocess(grads, params)
+    def update(self, grads, opt_state, params, lr, param_specs=None):
+        grads = self._preprocess(grads, params, param_specs)
         t = opt_state["t"] + 1
         m = _tmap(lambda m, g: self.b1 * m + (1 - self.b1) * g, opt_state["m"], grads)
         v = _tmap(
@@ -149,8 +193,8 @@ class RMSProp(Optimizer):
     def init_specs(self, param_specs):
         return {"sq": param_specs}
 
-    def update(self, grads, opt_state, params, lr):
-        grads = self._preprocess(grads, params)
+    def update(self, grads, opt_state, params, lr, param_specs=None):
+        grads = self._preprocess(grads, params, param_specs)
         sq = _tmap(
             lambda s, g: self.decay * s + (1 - self.decay) * jnp.square(g),
             opt_state["sq"], grads,

@@ -63,12 +63,12 @@ class BSPTrainer(BaseTrainer):
     # -- compilation ---------------------------------------------------------
     def compile_iter_fns(self) -> None:
         """Build + jit the train/eval steps (reference method name)."""
+        pspecs, sspecs, ospecs = self._spec_trees()
         local_step = make_local_step(
             self.model, self.optimizer, jax.random.PRNGKey(self.seed),
-            exchanger=self.exchanger,
+            exchanger=self.exchanger, param_specs=pspecs,
         )
         local_eval = make_local_eval(self.model, axes=self.exchanger.axis_name)
-        pspecs, sspecs, ospecs = self._spec_trees()
 
         self._step_fn = jax.jit(
             shard_map(

@@ -11,14 +11,17 @@ composes with the rules.  The scheme is the standard pair:
   matmul (bias added after, once).
 
 Layers run inside the rule's ``shard_map``; the *same* layer code runs
-unsharded too (plain jit, tests) because ``maybe_psum`` degrades to identity
-when the axis is absent.  Parameter placement comes from path-regex partition
-rules (the t5x/flax convention) rather than per-layer plumbing.
+unsharded too (plain jit, tests) because the Megatron ``f``/``g`` collective
+operators below degrade to identity when the axis is absent.  Parameter
+placement comes from path-regex partition rules (the t5x/flax convention)
+rather than per-layer plumbing.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import re
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -38,30 +41,103 @@ def axis_bound(axis_name: str) -> bool:
         return False
 
 
-def maybe_psum(x, axis_name: str = MODEL_AXIS):
-    """psum over ``axis_name`` if bound (shard_map), else identity (plain jit)."""
-    if axis_bound(axis_name):
-        return lax.psum(x, axis_name)
+# -- Megatron f/g collectives with pinned gradients ---------------------------
+#
+# Under shard_map(check_vma=False) the default transpose of ``psum`` does not
+# give the gradients tensor parallelism needs: the cotangent entering a
+# column-parallel matmul covers only that shard's feature slice, so the grads
+# of everything upstream (embeddings, LayerNorms) come out as per-shard
+# partials that silently diverge across model shards.  The standard fix
+# (Megatron-LM's f/g operators) pins both directions with custom VJPs:
+#
+# - ``g`` (row-parallel output): forward all-reduce; backward identity —
+#   the output cotangent is replicated and is exactly the cotangent of each
+#   shard's partial sum.
+# - ``f`` (column-parallel input): forward identity; backward all-reduce —
+#   each shard's input cotangent is the partial from its feature slice; the
+#   true cotangent is their sum.
+#
+# Both degrade to identity when the axis is unbound (plain jit) or size 1,
+# so the same layer code runs unsharded too.
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _g_op(x, axis_name: str):
+    return lax.psum(x, axis_name)
+
+
+def _g_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _g_bwd(axis_name, _, ct):
+    return (ct,)
+
+
+_g_op.defvjp(_g_fwd, _g_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _f_op(x, axis_name: str):
     return x
 
 
+def _f_fwd(x, axis_name):
+    return x, None
+
+
+def _f_bwd(axis_name, _, ct):
+    return (lax.psum(ct, axis_name),)
+
+
+_f_op.defvjp(_f_fwd, _f_bwd)
+
+
+def psum_fwd_identity_bwd(x, axis_name: str = MODEL_AXIS):
+    """Megatron ``g``: all-reduce in forward, pass-through in backward."""
+    if axis_bound(axis_name) and lax.axis_size(axis_name) > 1:
+        return _g_op(x, axis_name)
+    return x
+
+
+def identity_fwd_psum_bwd(x, axis_name: str = MODEL_AXIS):
+    """Megatron ``f``: pass-through in forward, all-reduce in backward."""
+    if axis_bound(axis_name) and lax.axis_size(axis_name) > 1:
+        return _f_op(x, axis_name)
+    return x
+
+
+@dataclasses.dataclass(frozen=True)
 class ColumnParallelDense(L.Dense):
     """Feature-sharded Dense: w ``P(None, model)``, b ``P(model)``.
 
-    Forward is communication-free; init sees the GLOBAL width (the host
-    builds full params; the trainer places shards per the partition rules).
+    Forward is communication-free (the replicated input is consumed as-is);
+    backward all-reduces the input cotangent (Megatron ``f`` — each shard
+    only produces the partial from its feature slice).  ``input_synced=True``
+    skips the ``f`` operator when the caller already applied it to a shared
+    input (e.g. attention applies it once for q/k/v instead of three times).
+    init sees the GLOBAL width (the host builds full params; the trainer
+    places shards per the partition rules).
     """
+
+    input_synced: bool = False
 
     @property
     def name(self) -> str:
         return "cpdense"
 
+    def apply(self, params, state, x, *, train=False, rng=None):
+        if not self.input_synced:
+            x = identity_fwd_psum_bwd(x, MODEL_AXIS)
+        return super().apply(params, state, x, train=train, rng=rng)
+
 
 class RowParallelDense(L.Dense):
     """Reduction-sharded Dense: w ``P(model, None)``; psum completes the sum.
 
-    The bias is added after the psum (adding before would apply it
-    ``model``-many times).
+    The psum is the Megatron ``g`` operator (backward = identity: the output
+    cotangent is replicated and already is the cotangent of each shard's
+    partial sum).  The bias is added after the psum (adding before would
+    apply it ``model``-many times).
     """
 
     @property
@@ -70,7 +146,7 @@ class RowParallelDense(L.Dense):
 
     def apply(self, params, state, x, *, train=False, rng=None):
         y = x @ params["w"].astype(x.dtype)
-        y = maybe_psum(y, MODEL_AXIS)
+        y = psum_fwd_identity_bwd(y, MODEL_AXIS)
         if self.use_bias:
             y = y + params["b"].astype(x.dtype)
         return y, state

@@ -48,7 +48,8 @@ def restack(tree):
     return jax.tree.map(lambda x: x[None], tree)
 
 
-def make_local_step(model, opt, base_key, exchanger=None, stacked=False):
+def make_local_step(model, opt, base_key, exchanger=None, stacked=False,
+                    param_specs=None):
     """The per-worker train step shared by every rule.
 
     ``exchanger`` set (BSP): gradients are mean-reduced across the data axis
@@ -56,6 +57,8 @@ def make_local_step(model, opt, base_key, exchanger=None, stacked=False):
     replicated.  ``stacked`` (EASGD/GOSGD): parameter trees carry a leading
     worker axis of size 1 per shard, the step is collective-free, and metrics
     come back per-worker (stacked) — averaging happens on host at print time.
+    ``param_specs`` (tensor parallelism) makes gradient clipping's global
+    norm exact across model shards (see :func:`ops.opt.global_sq_norm`).
     """
 
     # models with a non-standard update (e.g. the GAN two-optimizer step)
@@ -87,7 +90,9 @@ def make_local_step(model, opt, base_key, exchanger=None, stacked=False):
             )(params)
             if exchanger is not None:
                 grads = exchanger.exchange(grads)
-            new_params, new_opt_state = opt.update(grads, opt_state, params, lr)
+            new_params, new_opt_state = opt.update(
+                grads, opt_state, params, lr, param_specs=param_specs
+            )
         if stacked:
             return (
                 restack(new_params),
