@@ -34,7 +34,8 @@ def test_shard_tree_roundtrip(tmp_path):
     assert len(batches) == 5  # 40 // 8, across shard boundaries (shard=16)
     for b in batches:
         assert b["x"].shape == (8, 32, 32, 3)
-        assert b["x"].dtype == np.float32
+        # uint8 on the wire; normalization happens on device (norm_stats)
+        assert b["x"].dtype == np.uint8
         assert b["y"].shape == (8,)
     vb = list(d.val_batches(8))
     assert len(vb) == 3

@@ -1,0 +1,79 @@
+"""Recorder honesty: the prefetch dequeue stall must land in the wait split.
+
+Reference (SURVEY.md §3.5/§7 hard part 5): para_load's 'wait' segment
+measured the residual input stall after overlap.  Round-1 regression: the
+run() loop dequeued prefetched batches outside any recorder segment, so a
+starved pipeline reported wait ~= 0 (VERDICT.md weak #2).
+"""
+
+import time
+
+import jax
+import numpy as np
+
+from theanompi_tpu.models.wide_resnet import WideResNet
+from theanompi_tpu.parallel.bsp import BSPTrainer
+from theanompi_tpu.parallel.mesh import make_mesh
+from theanompi_tpu.utils.recorder import Recorder
+
+TINY = {
+    "depth": 10,
+    "widen": 1,
+    "batch_size": 8,
+    "n_epochs": 1,
+    "lr": 0.05,
+    "n_train": 64,
+    "n_val": 16,
+    "augment": False,
+    "precision": "fp32",
+    "verbose": False,
+}
+
+
+def _run_with_loader_delay(delay: float):
+    model = WideResNet(dict(TINY))
+    orig = model.data.train_batches
+
+    def slow_batches(*args, **kwargs):
+        for b in orig(*args, **kwargs):
+            if delay:
+                time.sleep(delay)
+            yield b
+
+    model.data.train_batches = slow_batches
+    t = BSPTrainer(
+        model,
+        mesh=make_mesh(n_data=1, devices=jax.devices()[:1]),
+        recorder=Recorder(verbose=False),
+        prefetch_depth=1,
+    )
+    return t.run()
+
+
+def test_starved_pipeline_reports_wait():
+    """A throttled loader must show up as wait time, one entry per iter."""
+    delay = 0.15
+    rec = _run_with_loader_delay(delay)
+    n_batches = TINY["n_train"] // TINY["batch_size"]
+    waits = rec.time_history["wait"]
+    assert len(waits) == n_batches
+    # the first dequeue may be partially hidden by compile; over the epoch
+    # the stall (8 x 150ms minus compute overlap) cannot stay near zero
+    assert sum(waits) > 0.3, f"starved pipeline hid its stall: {waits}"
+
+
+def test_fed_pipeline_wait_is_small():
+    """With an instant loader, wait must be a small share of calc."""
+    rec = _run_with_loader_delay(0.0)
+    wait, calc = sum(rec.time_history["wait"]), sum(rec.time_history["calc"])
+    assert wait < max(0.25 * calc, 0.2), (wait, calc)
+
+
+def test_cancel_discards_open_segment():
+    r = Recorder(verbose=False)
+    r.start("wait")
+    r.cancel("wait")
+    r.end_iteration()
+    assert r.time_history["wait"] == [0.0]
+    # cancel of a segment that was never started is a no-op
+    r.cancel("calc")

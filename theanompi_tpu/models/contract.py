@@ -142,7 +142,19 @@ class SupervisedModel(Model):
 
     def loss_fn(self, params, state, batch, rng, train: bool):
         x = batch["x"]
-        if jnp.issubdtype(x.dtype, jnp.floating):
+        if x.dtype == jnp.uint8:
+            # images travel host->device as uint8 (4x fewer bytes than
+            # fp32 — the transfer is the input pipeline's scarce resource);
+            # the cast+normalize runs on device, where XLA fuses it into
+            # the first conv
+            x = x.astype(self.precision.compute_dtype)
+            stats = getattr(self.data, "norm_stats", None)
+            if stats is not None:
+                mean, inv_std = stats
+                x = (x - jnp.asarray(mean, x.dtype)) * jnp.asarray(
+                    inv_std, x.dtype
+                )
+        elif jnp.issubdtype(x.dtype, jnp.floating):
             x = x.astype(self.precision.compute_dtype)  # int tokens stay int
         compute_params = self.precision.cast_to_compute(params)
         logits, new_state = self.net.apply(

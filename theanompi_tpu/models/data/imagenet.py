@@ -53,6 +53,14 @@ def center_crop(x: np.ndarray, out: int):
 
 
 def normalize(x: np.ndarray) -> np.ndarray:
+    """Host-side normalization (kept for tools/tests).
+
+    The training path does NOT use this: batches leave the loader as uint8
+    (4x fewer host→device bytes — the transfer is the input pipeline's
+    scarce resource on TPU) and the model normalizes on device via
+    ``Dataset.norm_stats``, where XLA fuses the cast+scale into the first
+    conv's HLO.
+    """
     return (x.astype(np.float32) - MEAN_RGB) / STD_RGB
 
 
@@ -123,24 +131,31 @@ class _SyntheticShards:
         self.shard_size = shard_size
         self.seed = seed
         self.n_shards = (n + shard_size - 1) // shard_size
+        self._pattern_cache: dict[int, np.ndarray] = {}
 
     def _pattern(self, cls: int) -> np.ndarray:
-        r = np.random.RandomState(1000003 + cls)
-        p = r.randint(60, 196, size=(8, 8, 3)).astype(np.float32)
-        reps = self.store_size // 8 + 1
-        return np.tile(p, (reps, reps, 1))[: self.store_size, : self.store_size]
+        """The class's 8x8x3 signature (cached small; tiled per shard)."""
+        p = self._pattern_cache.get(cls)
+        if p is None:
+            r = np.random.RandomState(1000003 + cls)
+            p = r.randint(60, 196, size=(8, 8, 3)).astype(np.float32)
+            self._pattern_cache[cls] = p
+        return p
 
     def iter_shards(self, order):
+        s = self.store_size
+        reps = s // 8 + 1
         for i in order:
             count = min(self.shard_size, self.n - i * self.shard_size)
-            r = np.random.RandomState(self.seed * 7919 + i)
-            y = r.randint(0, self.n_classes, count).astype(np.int32)
-            x = np.empty((count, self.store_size, self.store_size, 3), np.uint8)
-            for j in range(count):
-                img = self._pattern(int(y[j])) + r.randn(
-                    self.store_size, self.store_size, 3
-                ).astype(np.float32) * 24.0
-                x[j] = np.clip(img, 0, 255).astype(np.uint8)
+            r = np.random.default_rng(self.seed * 7919 + int(i))
+            y = r.integers(0, self.n_classes, count, dtype=np.int32)
+            # vectorized: stack small patterns, tile to store size, one
+            # fp32 noise draw for the whole shard (the per-image python
+            # loop was the host bottleneck at bench batch sizes)
+            pats = np.stack([self._pattern(int(c)) for c in y])
+            pats = np.tile(pats, (1, reps, reps, 1))[:, :s, :s]
+            noise = r.standard_normal((count, s, s, 3), dtype=np.float32)
+            x = np.clip(pats + noise * 24.0, 0, 255).astype(np.uint8)
             yield x, y
 
 
@@ -151,7 +166,14 @@ class ImageNetData(Dataset):
     default 224), ``store_size`` (stored resolution, default 256; synthetic
     only), ``n_classes`` (default 1000), and for the synthetic stand-in
     ``n_train``/``n_val``/``shard_size``.
+
+    Batches are uint8; models normalize on device using ``norm_stats``
+    (mean, inverse-std in [0,255] space) — see
+    :meth:`theanompi_tpu.models.contract.SupervisedModel.loss_fn`.
     """
+
+    #: on-device normalization constants: (mean, 1/std) in [0,255] RGB
+    norm_stats = (MEAN_RGB, (1.0 / STD_RGB).astype(np.float32))
 
     def __init__(self, config: dict | None = None):
         config = config or {}
@@ -217,7 +239,8 @@ class ImageNetData(Dataset):
             while have >= batch_size:
                 bx = np.concatenate(buf_x) if len(buf_x) > 1 else buf_x[0]
                 by = np.concatenate(buf_y) if len(buf_y) > 1 else buf_y[0]
-                yield {"x": normalize(bx[:batch_size]), "y": by[:batch_size]}
+                # uint8 out: normalization happens on device (norm_stats)
+                yield {"x": bx[:batch_size], "y": by[:batch_size]}
                 buf_x, buf_y = [bx[batch_size:]], [by[batch_size:]]
                 have -= batch_size
         # ragged tail dropped (constant shapes under jit)

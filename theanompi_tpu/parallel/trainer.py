@@ -295,8 +295,21 @@ class BaseTrainer:
                 depth=self.prefetch_depth,
                 spec=self.batch_spec,
             )
+            it = iter(batches)
             try:
-                for batch in batches:
+                while True:
+                    # the dequeue is the real input stall (para_load's 'wait'
+                    # — SURVEY.md §3.5); time it into the same per-iteration
+                    # wait bucket train_iter's residual shard_batch adds to,
+                    # so a starved pipeline reports wait > 0 instead of
+                    # hiding the stall in untracked loop time
+                    self.recorder.start("wait")
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        self.recorder.cancel("wait")
+                        break
+                    self.recorder.end("wait")
                     self.train_iter(batch, lr)
             finally:
                 # a step failure must not leave the loader thread pinning

@@ -52,6 +52,11 @@ class Recorder:
             jax.block_until_ready(fence)
         self._iter_times[what] += time.perf_counter() - self._t0.pop(what)
 
+    def cancel(self, what: str) -> None:
+        """Abandon an open segment without recording it (e.g. the wait
+        opened before a ``next()`` that raised StopIteration)."""
+        self._t0.pop(what, None)
+
     def end_iteration(self) -> None:
         for seg in SEGMENTS:
             self.time_history[seg].append(self._iter_times.get(seg, 0.0))
