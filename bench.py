@@ -118,42 +118,6 @@ def step_flops(trainer, batch) -> float | None:
         return None
 
 
-def run_trial(trainer, batches, steps: int, feed_mode: str):
-    """One timed trial.  -> (seconds, steps run, input wait seconds)."""
-    from theanompi_tpu.models.data.prefetch import prefetch
-
-    rec = trainer.recorder
-    rec.time_history.clear()
-    if feed_mode == "prefetch":
-        rotation = (batches[i % len(batches)] for i in range(steps))
-        feed = prefetch(rotation, mesh=trainer.mesh, depth=4,
-                        spec=trainer.batch_spec)
-    else:
-        feed = [batches[i % len(batches)] for i in range(steps)]
-    t0 = time.perf_counter()
-    n = 0
-    m = None
-    it = iter(feed)
-    try:
-        while True:
-            rec.start("wait")  # run()-loop parity: time the dequeue stall
-            try:
-                b = next(it)
-            except StopIteration:
-                rec.cancel("wait")
-                break
-            rec.end("wait")
-            m = trainer.train_iter(b, lr=0.01)
-            n += 1
-    finally:
-        close = getattr(feed, "close", None)
-        if close:
-            close()
-    float(m["cost"])  # single sync: drain the whole dispatched chain
-    dt = time.perf_counter() - t0
-    return dt, n, float(np.sum(rec.time_history["wait"]))
-
-
 def main():
     platform = jax.devices()[0].platform
     model_name = os.environ.get("BENCH_MODEL", "resnet50")
@@ -187,10 +151,12 @@ def main():
     else:
         batches = host_batches
 
-    results = [run_trial(trainer, batches, steps, feed_mode)
-               for _ in range(trials)]
-    per_trial = [n * bs / dt for dt, n, _ in results]
-    dt, n, wait_s = min(results, key=lambda r: r[0] / r[1])
+    from theanompi_tpu.utils.benchlib import best_trial
+
+    (dt, n, wait_s), results = best_trial(
+        trainer, batches, steps, trials, feed_mode=feed_mode
+    )
+    per_trial = [tn * bs / tdt for tdt, tn, _ in results]
 
     images_per_sec = n * bs / dt
     base = NOMINAL.get((model_name, platform), images_per_sec)

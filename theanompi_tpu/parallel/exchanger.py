@@ -47,6 +47,18 @@ def register_strategy(name: str):
     return deco
 
 
+@register_strategy("none")
+def _no_exchange(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
+    """No-op strategy: skip the collective entirely.
+
+    Replicas diverge — NOT for training.  Exists for the scaling harness's
+    differential comm measurement (step time with vs. without the exchange
+    is the honest comm-share proxy when the collective is fused into one
+    XLA program and invisible to host-side timers).
+    """
+    return x
+
+
 @register_strategy("psum")
 def _psum_mean(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
     """Plain all-reduce mean (reference ``ar``/``nccl32``)."""
@@ -145,7 +157,7 @@ class Exchanger:
                 f"available: {sorted(STRATEGIES)}"
             )
         if isinstance(axis_name, (tuple, list)) and len(axis_name) > 1:
-            if strategy not in ("psum", "psum_bf16"):
+            if strategy not in ("psum", "psum_bf16", "none"):
                 raise ValueError(
                     f"strategy {strategy!r} reduces over a single ring; "
                     f"multi-axis exchange ({axis_name}) needs 'psum'/'psum_bf16'"

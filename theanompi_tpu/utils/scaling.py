@@ -1,0 +1,161 @@
+"""Scaling-efficiency measurement harness (the north-star metric's tool).
+
+BASELINE.json's north star is >= 90% linear BSP scaling efficiency for
+ResNet-50 on a TPU pod; the reference paper's headline was near-linear
+AlexNet speedup to 8 GPUs (SURVEY.md §6, unverified).  This harness makes
+that checkable: for each worker count n it measures pipelined step time on an
+n-device data mesh and reports
+
+- **weak-scaling efficiency**: images/sec/chip at n relative to n=1 (the
+  per-worker batch is fixed, the global batch grows with n — the reference's
+  setting);
+- **comm share**: the fraction of step time attributable to the gradient
+  exchange, measured *differentially* (same step compiled with the ``none``
+  strategy) because the collective is fused into the XLA program and
+  invisible to host-side segment timers.
+
+Run on the CPU fake mesh (collectives are memcpys — the harness validates
+the *machinery* and gives an upper bound on framework overhead) or on a real
+multi-chip slice (the numbers that count).  CLI::
+
+    python -m theanompi_tpu.utils.scaling --ns 1,2,4,8 --out SCALING.json
+    # no multi-chip hardware? add --virtual 8 (forces host devices)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+
+def _build(model_name: str, model_config: dict, n: int, strategy: str):
+    import jax
+
+    from theanompi_tpu.parallel.bsp import BSPTrainer
+    from theanompi_tpu.parallel.mesh import make_mesh
+    from theanompi_tpu.utils.helper_funcs import import_model, shard_batch
+    from theanompi_tpu.utils.recorder import Recorder
+
+    model_cls = import_model(f"theanompi_tpu.models.{model_name}",
+                             {"wide_resnet": "WideResNet",
+                              "resnet50": "ResNet50",
+                              "alex_net": "AlexNet"}.get(model_name, model_name))
+    cfg = dict(model_config)
+    if n > 1:
+        cfg.setdefault("bn_axis", "data")  # BSP default: sync-BN
+    model = model_cls(cfg)
+    mesh = make_mesh(n_data=n, devices=jax.devices()[:n])
+    trainer = BSPTrainer(model, mesh=mesh, exch_strategy=strategy,
+                         recorder=Recorder(verbose=False, print_freq=10**9))
+    trainer.compile_iter_fns()
+    trainer.init_state()
+    batches = [
+        shard_batch(mesh, b, spec=trainer.batch_spec)
+        for b in model.data.train_batches(trainer.global_batch, 0, seed=0)
+    ]
+    jax.block_until_ready(batches)
+    return trainer, batches
+
+
+def measure_scaling(
+    model_name: str = "wide_resnet",
+    model_config: dict | None = None,
+    ns=(1, 2, 4, 8),
+    steps: int = 10,
+    trials: int = 3,
+    strategy: str = "psum",
+    out_path: str | None = None,
+) -> dict:
+    """-> the artifact dict (and writes it to ``out_path`` if given)."""
+    import jax
+
+    from theanompi_tpu.utils.benchlib import best_trial
+
+    model_config = model_config or {
+        "batch_size": 32, "n_train": 256, "n_val": 64,
+        "n_epochs": 1, "augment": False, "verbose": False,
+    }
+    per_n = {}
+    for n in ns:
+        trainer, batches = _build(model_name, model_config, n, strategy)
+        # warmup: compile both programs' first dispatch
+        m = trainer.train_iter(batches[0], lr=0.01)
+        float(m["cost"])
+        (dt, _, _), results = best_trial(trainer, batches, steps, trials)
+        times = [r[0] for r in results]
+
+        t_noex = dt
+        if n > 1:
+            tr2, b2 = _build(model_name, model_config, n, "none")
+            m = tr2.train_iter(b2[0], lr=0.01)
+            float(m["cost"])
+            (t_noex, _, _), _ = best_trial(tr2, b2, steps, trials)
+
+        ips = steps * trainer.global_batch / dt
+        per_n[int(n)] = {
+            "global_batch": trainer.global_batch,
+            "step_ms": round(dt / steps * 1e3, 3),
+            "imgs_per_sec": round(ips, 2),
+            "imgs_per_sec_per_chip": round(ips / n, 2),
+            "comm_share": round(max(0.0, 1.0 - t_noex / dt), 4) if n > 1 else 0.0,
+            "trial_s": [round(t, 4) for t in times],
+        }
+    for n in ns:
+        per_n[int(n)]["efficiency"] = round(
+            per_n[int(n)]["imgs_per_sec_per_chip"]
+            / per_n[int(ns[0])]["imgs_per_sec_per_chip"],
+            4,
+        )
+    artifact = {
+        "model": model_name,
+        "strategy": strategy,
+        "platform": jax.devices()[0].platform,
+        "steps": steps,
+        "trials": trials,
+        "ns": [int(n) for n in ns],
+        # efficiency is relative to the SMALLEST measured n; only a run
+        # whose ns include 1 measures the true vs-one-chip north star
+        "efficiency_base_n": int(ns[0]),
+        "per_n": per_n,
+        "north_star": "efficiency >= 0.9 at pod scale (BASELINE.json)",
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+    return artifact
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--model", default="wide_resnet")
+    p.add_argument("--ns", default="1,2,4,8")
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--trials", type=int, default=3)
+    p.add_argument("--strategy", default="psum")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--out", default="SCALING.json")
+    p.add_argument("--virtual", type=int, default=0,
+                   help="force N virtual host (CPU) devices first")
+    args = p.parse_args(argv)
+    if args.virtual:
+        from theanompi_tpu.parallel.mesh import force_host_devices
+
+        force_host_devices(args.virtual)
+    ns = tuple(int(x) for x in args.ns.split(","))
+    cfg = {"batch_size": args.batch_size, "n_train": max(256, args.batch_size * 8),
+           "n_val": 64, "n_epochs": 1, "augment": False, "verbose": False}
+    art = measure_scaling(args.model, cfg, ns=ns, steps=args.steps,
+                          trials=args.trials, strategy=args.strategy,
+                          out_path=args.out)
+    for n in art["ns"]:
+        r = art["per_n"][n]
+        print(f"n={n}: {r['imgs_per_sec']:9.1f} img/s "
+              f"({r['imgs_per_sec_per_chip']:8.1f}/chip)  "
+              f"eff {r['efficiency']:5.3f}  comm {r['comm_share']:5.3f}")
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
