@@ -35,11 +35,9 @@ from __future__ import annotations
 
 import json
 import os
-import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 # Round-1 nominal throughput (images/sec) per (model, platform) — the
 # denominator for vs_baseline.  Backfill real reference numbers if the

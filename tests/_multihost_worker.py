@@ -1,0 +1,90 @@
+"""Worker for the 2-process jax.distributed integration test.
+
+Launched (twice) by tests/test_multihost.py.  Each process owns 4 virtual
+CPU devices; the mesh spans all 8.  This is the multi-controller shape of a
+TPU pod (SURVEY.md §3.1's mpirun process boundary, re-based on the JAX
+runtime): the SAME program runs on every host, data/init are seed-identical,
+and each process feeds only its addressable shards.
+
+Usage: python _multihost_worker.py <pid> <port> <ckpt_dir0> <ckpt_dir1>
+(the per-process checkpoint dirs differ to prove resume does NOT need a
+shared filesystem: only process 0's disk is authoritative).
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, port, dir0, dir1 = (sys.argv[1], sys.argv[2], sys.argv[3],
+                             sys.argv[4])
+    pid = int(pid)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=2,
+        process_id=pid,
+        local_device_ids=list(range(4)),
+    )
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8
+
+    import numpy as np
+
+    from theanompi_tpu.models.wide_resnet import WideResNet
+    from theanompi_tpu.parallel.bsp import BSPTrainer
+    from theanompi_tpu.parallel.mesh import make_mesh
+    from theanompi_tpu.utils.recorder import Recorder
+
+    cfg = {
+        "depth": 10, "widen": 1, "batch_size": 4, "n_epochs": 2,
+        "lr": 0.05, "n_train": 64, "n_val": 32, "augment": False,
+        "precision": "fp32", "verbose": False, "bn_axis": "data",
+    }
+    my_ckpt = dir0 if pid == 0 else dir1
+
+    def build():
+        model = WideResNet(dict(cfg))
+        t = BSPTrainer(
+            model,
+            mesh=make_mesh(n_data=8),
+            recorder=Recorder(verbose=False),
+            checkpoint_dir=my_ckpt,
+        )
+        t.compile_iter_fns()
+        t.init_state()
+        return t
+
+    trainer = build()
+    rec = trainer.run()
+    costs = rec.val_history["cost"]
+    assert len(costs) == 2 and all(np.isfinite(c) for c in costs), costs
+
+    # resume on a FRESH trainer: process 1's dir is empty — the resume
+    # decision and the arrays must both come from process 0 via broadcast
+    resumed = build()
+    ok = resumed.try_resume()
+    assert ok, "resume failed"
+    assert resumed.epoch == 2, resumed.epoch
+
+    # restored params must equal the trained ones on every process
+    for a, b in zip(jax.tree.leaves(trainer.params),
+                    jax.tree.leaves(resumed.params)):
+        np.testing.assert_array_equal(
+            np.asarray(a.addressable_shards[0].data),
+            np.asarray(b.addressable_shards[0].data),
+        )
+
+    # one more epoch from the restored state exercises train-after-resume
+    resumed.model.n_epochs = 3
+    resumed.run()
+
+    print(f"MULTIHOST_OK pid={pid} val_cost={costs[-1]:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

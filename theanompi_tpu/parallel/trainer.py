@@ -126,11 +126,13 @@ def stack_for_workers(mesh, tree, n: int):
     The device layout of "every worker has its own copy" — each leaf becomes
     ``(n, *shape)`` with shard ``i`` resident on worker ``i``'s devices.
     """
+    from theanompi_tpu.utils.helper_funcs import put_global
+
     sharding = NamedSharding(mesh, P(DATA_AXIS))
 
     def tile(x):
         x = np.asarray(x)
-        return jax.device_put(np.broadcast_to(x, (n, *x.shape)).copy(), sharding)
+        return put_global(np.broadcast_to(x, (n, *x.shape)).copy(), sharding)
 
     return jax.tree.map(tile, tree)
 

@@ -24,19 +24,25 @@ import numpy as np
 
 def _to_host(leaf) -> np.ndarray:
     if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
-        # multi-host pod: this host holds only its shards; gather the global
-        # value (a collective — every process must reach this point)
+        if leaf.is_fully_replicated:
+            # every device holds the whole value; read a local shard
+            return np.asarray(leaf.addressable_shards[0].data)
+        # multi-host pod, cross-host-sharded leaf: gather the global value
+        # (a collective — every process must reach this point)
         from jax.experimental import multihost_utils
 
         leaf = multihost_utils.process_allgather(leaf, tiled=True)
     return np.asarray(leaf)
 
 
+def _leaf_key(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+
 def _flatten(tree) -> dict[str, np.ndarray]:
     out = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
-        out[key] = _to_host(leaf)
+        out[_leaf_key(path)] = _to_host(leaf)
     return out
 
 
@@ -44,7 +50,7 @@ def _restore_into(template, arrays: dict[str, np.ndarray]):
     leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
     new_leaves = []
     for path, leaf in leaves:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        key = _leaf_key(path)
         if key not in arrays:
             raise KeyError(f"checkpoint missing leaf {key!r}")
         arr = arrays[key]
@@ -54,7 +60,9 @@ def _restore_into(template, arrays: dict[str, np.ndarray]):
                 f"expected {tuple(leaf.shape)}"
             )
         if isinstance(leaf, jax.Array):
-            arr = jax.device_put(arr.astype(leaf.dtype), leaf.sharding)
+            from theanompi_tpu.utils.helper_funcs import put_global
+
+            arr = put_global(arr.astype(leaf.dtype), leaf.sharding)
         new_leaves.append(arr)
     return jax.tree_util.tree_unflatten(
         jax.tree_util.tree_structure(template), new_leaves
@@ -102,24 +110,50 @@ class Checkpointer:
         for f in ckpts[: max(0, len(ckpts) - self.keep)]:
             os.remove(os.path.join(self.directory, f))
 
-    def latest_epoch(self) -> int | None:
+    def _local_latest(self) -> tuple[int, int]:
+        """(epoch, iteration) from the LOCAL filesystem; (-1, 0) if none."""
         p = os.path.join(self.directory, "latest.json")
         if not os.path.exists(p):
-            return None
+            return -1, 0
         with open(p) as f:
             meta = json.load(f)
-        return meta["epoch"] if os.path.exists(self._path(meta["epoch"])) else None
+        if not os.path.exists(self._path(meta["epoch"])):
+            return -1, 0
+        return meta["epoch"], meta.get("iteration", 0)
+
+    def _synced_latest(self) -> tuple[int, int]:
+        """Process-0's latest, agreed on every process.
+
+        Only process 0 writes checkpoints, so only its filesystem is
+        authoritative; without this broadcast a non-shared checkpoint dir
+        would leave process 0 resuming while the others start fresh —
+        desynchronizing the SPMD program at the first collective.
+        """
+        ep, it = self._local_latest()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            ep, it = (int(v) for v in multihost_utils.broadcast_one_to_all(
+                np.array([ep, it], np.int64)))
+        return ep, it
+
+    def latest_epoch(self) -> int | None:
+        ep, _ = self._synced_latest()
+        return None if ep < 0 else ep
 
     def latest_iteration(self) -> int:
-        p = os.path.join(self.directory, "latest.json")
-        if not os.path.exists(p):
-            return 0
-        with open(p) as f:
-            return json.load(f).get("iteration", 0)
+        return self._synced_latest()[1]
 
     def load(self, epoch: int, templates: dict) -> dict:
         """Restore each named pytree into the matching template's structure
-        and shardings."""
+        and shardings.
+
+        Multi-host: process 0 reads the file and the arrays are broadcast,
+        so the checkpoint dir does NOT need to be a shared filesystem (it
+        only ever needs process 0's disk).
+        """
+        if jax.process_count() > 1:
+            return self._load_multihost(epoch, templates)
         with np.load(self._path(epoch)) as z:
             arrays = {k: z[k] for k in z.files}
         out = {}
@@ -129,5 +163,70 @@ class Checkpointer:
                 for k, v in arrays.items()
                 if k.startswith(f"{name}::")
             }
+            out[name] = _restore_into(template, sub)
+        return out
+
+    @staticmethod
+    def _template_placeholders(template) -> dict[str, np.ndarray]:
+        """Zero arrays with the template's leaf keys/shapes/dtypes."""
+        return {
+            _leaf_key(path): np.zeros(
+                getattr(leaf, "shape", ()), getattr(leaf, "dtype", np.float32)
+            )
+            for path, leaf in jax.tree_util.tree_flatten_with_path(template)[0]
+        }
+
+    def _load_multihost(self, epoch: int, templates: dict) -> dict:
+        """Process 0 reads + validates, then broadcasts to every process.
+
+        Validation (missing leaves, shape mismatches) and dtype coercion
+        happen on process 0 BEFORE any collective: a one-sided raise inside
+        the broadcast would leave the other processes hung in a collective
+        that never completes, and mismatched per-process avals would fail
+        opaquely inside Gloo/XLA instead of with the diagnostic.  The
+        verdict is broadcast as a status flag so every process raises.
+        """
+        from jax.experimental import multihost_utils
+
+        subs: dict[str, dict[str, np.ndarray]] = {}
+        err = ""
+        if jax.process_index() == 0:
+            try:
+                with np.load(self._path(epoch)) as z:
+                    arrays = {k: z[k] for k in z.files}
+                for name, template in templates.items():
+                    sub = {}
+                    tleaves = jax.tree_util.tree_flatten_with_path(template)[0]
+                    for path, leaf in tleaves:
+                        key = _leaf_key(path)
+                        if f"{name}::{key}" not in arrays:
+                            raise KeyError(f"checkpoint missing leaf {key!r}")
+                        arr = arrays[f"{name}::{key}"]
+                        tshape = tuple(getattr(leaf, "shape", arr.shape))
+                        if tuple(arr.shape) != tshape:
+                            raise ValueError(
+                                f"checkpoint leaf {key!r} shape {arr.shape}"
+                                f" != expected {tshape}"
+                            )
+                        # match the placeholders' dtype so the broadcast's
+                        # per-process avals agree
+                        sub[key] = arr.astype(
+                            getattr(leaf, "dtype", np.float32))
+                    subs[name] = sub
+            except (OSError, KeyError, ValueError) as e:
+                err = f"{type(e).__name__}: {e}"
+                print(f"checkpoint restore failed on process 0: {err}",
+                      flush=True)
+        failed = multihost_utils.broadcast_one_to_all(
+            np.array([1 if err else 0], np.int64))
+        if int(failed[0]):
+            raise RuntimeError(
+                "multi-host checkpoint restore failed on process 0 "
+                "(see its log)" + (f": {err}" if err else "")
+            )
+        out = {}
+        for name, template in templates.items():
+            sub = subs.get(name) or self._template_placeholders(template)
+            sub = multihost_utils.broadcast_one_to_all(sub)
             out[name] = _restore_into(template, sub)
         return out

@@ -34,22 +34,43 @@ def import_model(modelfile: str, modelclass: str):
         ) from e
 
 
+def put_global(x, sharding: NamedSharding):
+    """Place a host-GLOBAL array onto a (possibly multi-host) sharding.
+
+    Single host: plain ``device_put``.  Multi-host mesh (some devices belong
+    to other processes — SURVEY.md §3.1's process boundary, now a
+    multi-controller jax runtime): every process holds the same global value
+    (deterministic data/init — same seed everywhere) and contributes only
+    the shards its local devices own.
+    """
+    if isinstance(x, jax.Array) and x.sharding == sharding:
+        return x
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    x = np.asarray(x)
+    idx_map = sharding.addressable_devices_indices_map(x.shape)
+    arrs = [jax.device_put(x[idx], d) for d, idx in idx_map.items()]
+    return jax.make_array_from_single_device_arrays(x.shape, sharding, arrs)
+
+
 def shard_batch(mesh: Mesh, batch: dict, spec: P | None = None) -> dict:
     """Place a host batch on the mesh.
 
     ``spec`` gives the leading-dims partition (``P("data")`` default,
     ``P("data", "seq")`` for sequence-parallel models); it is truncated to
-    each leaf's rank, remaining dims replicated.
+    each leaf's rank, remaining dims replicated.  Batches are GLOBAL: on a
+    multi-host mesh every process iterates the same (seed-deterministic)
+    batch stream and keeps only its local devices' rows.
     """
     spec = spec if spec is not None else P(DATA_AXIS)
 
     def put(x):
         if not isinstance(x, jax.Array):
             # np.asarray would silently pull an already-placed (prefetched)
-            # batch back to host; device_put below is a no-op for those
+            # batch back to host; put_global below is a no-op for those
             x = np.asarray(x)
         leaf_spec = P(*spec[: x.ndim], *([None] * max(0, x.ndim - len(spec))))
-        return jax.device_put(x, NamedSharding(mesh, leaf_spec))
+        return put_global(x, NamedSharding(mesh, leaf_spec))
 
     return jax.tree.map(put, batch)
 
@@ -57,14 +78,14 @@ def shard_batch(mesh: Mesh, batch: dict, spec: P | None = None) -> dict:
 def place(mesh: Mesh, tree, specs):
     """Place a pytree with a matching pytree of PartitionSpecs."""
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+        lambda x, s: put_global(x, NamedSharding(mesh, s)), tree, specs
     )
 
 
 def replicate(mesh: Mesh, tree):
     """Replicate a pytree across every device of the mesh."""
     sharding = NamedSharding(mesh, P())
-    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+    return jax.tree.map(lambda x: put_global(x, sharding), tree)
 
 
 def tree_bytes(tree) -> int:
