@@ -182,21 +182,26 @@ def test_gosgd_e2e(mesh8):
     assert (w > 0).all()
 
 
-def test_easgd_single_worker_noop_exchange():
-    """n=1: elastic exchange must leave params == center (alpha cancels)."""
+def test_easgd_single_worker_exact_exchange():
+    """n=1 elastic exchange is exact: p' = p - a(p-c), c' = c + a(p-c)."""
     from theanompi_tpu.parallel.mesh import make_mesh
 
     mesh = make_mesh(n_data=1, devices=jax.devices()[:1])
     from theanompi_tpu.models.wide_resnet import WideResNet
 
     model = WideResNet({**TINY, "n_epochs": 1})
-    t = EASGDTrainer(model, mesh=mesh, tau=1)
+    t = EASGDTrainer(model, mesh=mesh, tau=10**9)  # no exchange inside step
+    assert t.alpha == 0.9  # paper default 0.9/n at n=1
     t.compile_iter_fns()
     t.init_state()
     batch = next(iter(model.data.train_batches(t.global_batch, 0, seed=0)))
-    t.train_iter(batch, lr=0.05)
-    p = np.asarray(jax.tree.leaves(t.params)[0])[0]
-    c = np.asarray(jax.tree.leaves(t.center)[0])
-    # after exchange: p - a(p-c) and c + a(p-c) move toward each other but
-    # with n=1 they must agree after repeated exchanges; just check finite
-    assert np.isfinite(p).all() and np.isfinite(c).all()
+    t.train_iter(batch, lr=0.05)  # diverge worker from center
+    leaf = lambda tree, i: np.asarray(jax.tree.leaves(tree)[i])
+    p0, c0 = leaf(t.params, 0)[0].copy(), leaf(t.center, 0).copy()
+    assert not np.allclose(p0, c0)  # the step must have moved the worker
+    new_p, new_c = t._exchange_fn(t.params, t.center)
+    a = t.alpha
+    np.testing.assert_allclose(
+        leaf(new_p, 0)[0], p0 - a * (p0 - c0), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        leaf(new_c, 0), c0 + a * (p0 - c0), rtol=1e-5, atol=1e-6)

@@ -73,6 +73,80 @@ def test_alexnet_param_count():
     assert 55e6 < n < 65e6, f"AlexNet param count off: {n/1e6:.1f}M"
 
 
+def test_googlenet_aux_heads():
+    """aux=True: two aux heads add params, train loss includes them (weight
+    0.3), eval drops them (SURVEY.md §2.1 GoogLeNet row; Szegedy 2014 §5)."""
+    from theanompi_tpu.models.googlenet import GoogLeNet
+
+    cfg = {**COMMON, "image_size": 64, "n_classes": 13, "lrn": True}
+    plain = GoogLeNet(cfg)
+    auxed = GoogLeNet({**cfg, "aux": True})
+    p0, _ = plain.init_params(jax.random.PRNGKey(0))
+    p1, _ = auxed.init_params(jax.random.PRNGKey(0))
+    assert "aux0" in p1 and "aux1" in p1 and "aux0" not in p0
+    assert tree_count(p1) > tree_count(p0)
+
+    t = BSPTrainer(auxed, mesh=make_mesh(n_data=1, devices=jax.devices()[:1]))
+    t.compile_iter_fns()
+    t.init_state()
+    before = jax.tree.map(np.array, t.params["aux0"])
+    batch = next(iter(auxed.data.train_batches(t.global_batch, 0, seed=0)))
+    m = t.train_iter(batch, lr=0.01)
+    assert np.isfinite(float(m["cost"]))
+    moved = any(
+        not np.allclose(np.asarray(a), b)
+        for a, b in zip(jax.tree.leaves(t.params["aux0"]), jax.tree.leaves(before))
+    )
+    assert moved, "aux head got no gradient"
+    # train cost includes the 0.3-weighted aux losses; eval must not
+    v = t.validate(0)
+    assert np.isfinite(v["cost"])
+    train_loss, _ = auxed.loss_fn(t.params, t.state, {
+        "x": batch["x"][:4], "y": batch["y"][:4]}, jax.random.PRNGKey(1), True)
+    eval_loss, _ = auxed.loss_fn(t.params, t.state, {
+        "x": batch["x"][:4], "y": batch["y"][:4]}, None, False)
+    # near init all three heads sit at ~ln(13) each, so train > eval strictly
+    assert float(train_loss) > float(eval_loss)
+
+
+def test_googlenet_aux_full_size_pool_shape():
+    """At 224 the aux tap is 14x14 -> the paper's 5x5/3 pool path is used."""
+    from theanompi_tpu.models.googlenet import GoogLeNet
+
+    m = GoogLeNet({**COMMON, "image_size": 224, "n_classes": 1000,
+                   "aux": True})
+    # conv (not dense) first aux layer == the 5x5/3 avgpool branch
+    head = m.net.heads[0]
+    from theanompi_tpu.ops import layers as L
+
+    assert isinstance(head.layers[0], L.AvgPool)
+    assert isinstance(head.layers[1], L.Conv2D)
+
+
+def test_alexnet_grouped_convs():
+    """grouped=True: 2-group conv2/4/5 (Krizhevsky split) — fewer params,
+    still trains."""
+    from theanompi_tpu.models.alex_net import AlexNet
+
+    cfg = {**COMMON, "image_size": 224, "n_classes": 1000}
+    n_plain = tree_count(AlexNet(cfg).init_params(jax.random.PRNGKey(0))[0])
+    grouped = AlexNet({**cfg, "grouped": True})
+    n_grouped = tree_count(grouped.init_params(jax.random.PRNGKey(0))[0])
+    # grouping halves conv2/4/5 weight fan-in: exactly
+    # (5*5*96*256 + 3*3*384*384 + 3*3*384*256)/2 = 1,413,120 fewer params
+    assert n_plain - n_grouped == 1_413_120, (n_plain, n_grouped)
+    assert 55e6 < n_grouped < 62e6
+
+    small = AlexNet({**COMMON, "image_size": 64, "n_classes": 11,
+                     "grouped": True})
+    t = BSPTrainer(small, mesh=make_mesh(n_data=1, devices=jax.devices()[:1]))
+    t.compile_iter_fns()
+    t.init_state()
+    batch = next(iter(small.data.train_batches(t.global_batch, 0, seed=0)))
+    m = t.train_iter(batch, lr=0.01)
+    assert np.isfinite(float(m["cost"]))
+
+
 def test_lstm_one_step_and_perplexity():
     from theanompi_tpu.models.lstm import LSTM
 

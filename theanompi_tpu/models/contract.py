@@ -128,6 +128,9 @@ class SupervisedModel(Model):
     ``{"x": [B, ...], "y": [B] int}``.
     """
 
+    #: weight on auxiliary-head losses (train-time only; GoogLeNet paper §5)
+    aux_loss_weight = 0.3
+
     def __init__(self, config=None):
         super().__init__(config)
         self.net, self.in_shape = self.build_net()
@@ -140,8 +143,15 @@ class SupervisedModel(Model):
         self._out_shape = out_shape
         return params, state
 
-    def loss_fn(self, params, state, batch, rng, train: bool):
-        x = batch["x"]
+    def apply_net(self, params, state, x, *, train, rng):
+        """-> (logits, aux_logits, new_state).  Models with auxiliary
+        classifier heads override to return per-head logits during training;
+        the shared ``loss_fn`` folds them in at ``aux_loss_weight`` so l2 and
+        metrics handling stay in one place."""
+        logits, new_state = self.net.apply(params, state, x, train=train, rng=rng)
+        return logits, (), new_state
+
+    def prepare_x(self, x):
         if x.dtype == jnp.uint8:
             # images travel host->device as uint8 (4x fewer bytes than
             # fp32 — the transfer is the input pipeline's scarce resource);
@@ -156,11 +166,19 @@ class SupervisedModel(Model):
                 )
         elif jnp.issubdtype(x.dtype, jnp.floating):
             x = x.astype(self.precision.compute_dtype)  # int tokens stay int
+        return x
+
+    def loss_fn(self, params, state, batch, rng, train: bool):
+        x = self.prepare_x(batch["x"])
         compute_params = self.precision.cast_to_compute(params)
-        logits, new_state = self.net.apply(
+        logits, aux_logits, new_state = self.apply_net(
             compute_params, state, x, train=train, rng=rng
         )
         loss = softmax_cross_entropy(logits, batch["y"])
+        for a in aux_logits:
+            loss = loss + self.aux_loss_weight * softmax_cross_entropy(
+                a, batch["y"]
+            )
         if self.config.get("l2", 0.0):
             # reference models folded L2 into the graph cost; weight_decay on
             # the optimizer is the decoupled alternative

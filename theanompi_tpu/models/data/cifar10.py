@@ -26,10 +26,9 @@ STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
 def pad_crop_mirror(x: np.ndarray, rng: np.random.RandomState, pad: int = 4):
     """Random pad-crop + horizontal mirror (the reference's augmentations).
 
-    Host-side numpy, currently synchronous with the train loop; the
-    para_load-equivalent prefetch thread (planned, see
-    ``theanompi_tpu/models/data/__init__.py``) will move it off the critical
-    path.
+    Host-side numpy; it runs inside the loader generator, which the
+    para_load-equivalent prefetch thread
+    (:mod:`theanompi_tpu.models.data.prefetch`) overlaps with device compute.
     """
     n, h, w, c = x.shape
     padded = np.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="reflect")

@@ -3,10 +3,15 @@
 Reference (unverified — SURVEY.md §2.1): ``theanompi/models/googlenet.py`` —
 Szegedy et al. 2014: stem (7x7/2 conv, LRN-era norms), nine inception
 modules (1x1 / 1x1→3x3 / 1x1→5x5 / pool→1x1 branches, channel-concat),
-global average pool, FC.  The paper's auxiliary classifiers existed only to
-help 2014-era optimization; they are off by default here (``aux=False``) —
-with BN available ("bn": True) they are unnecessary, and omitting them keeps
-the training graph a single path XLA fuses well.
+global average pool, FC, plus two auxiliary classifiers tapped after
+inception 4a and 4d whose losses are added at weight 0.3 during training
+and dropped at eval (paper §5).
+
+The aux heads are behind the ``aux`` config knob, **off by default**: they
+existed to help 2014-era optimization, and without them the training graph
+is a single path XLA fuses well.  With ``aux=True`` the trunk runs in three
+segments so the tap activations feed the heads; eval always runs the main
+path only.
 """
 
 from __future__ import annotations
@@ -72,7 +77,8 @@ class _Inception(L.Layer):
         return jnp.concatenate(outs, axis=-1), new_state
 
 
-# (module name, spec) in network order, with 'P' = 3x3/2 max-pool
+# (module name, spec) in network order, with 'P' = 3x3/2 max-pool; the two
+# aux-classifier taps (paper §5) sit after 4a and 4d
 _PLAN = (
     ("3a", (64, 96, 128, 16, 32, 32)),
     ("3b", (128, 128, 192, 32, 96, 64)),
@@ -88,6 +94,81 @@ _PLAN = (
 )
 
 
+@dataclasses.dataclass(frozen=True)
+class _TrunkWithTaps(L.Layer):
+    """Trunk split at the aux taps; heads consume the tap activations.
+
+    ``apply`` is the main path only (eval, and training with ``aux=False``);
+    ``apply_with_aux`` additionally returns the two aux-head logits.
+    """
+
+    segs: tuple  # (stem..4a, 4b..4d, 4e..logits)
+    heads: tuple = ()  # (aux1, aux2) or empty
+
+    def init(self, key, in_shape):
+        keys = jax.random.split(key, len(self.segs) + len(self.heads))
+        params, state = {}, {}
+        shape = tuple(in_shape)
+        taps = []
+        for i, seg in enumerate(self.segs):
+            p, s, shape = seg.init(keys[i], shape)
+            params[f"seg{i}"] = p
+            if s:
+                state[f"seg{i}"] = s
+            taps.append(shape)
+        for i, head in enumerate(self.heads):
+            p, s, _ = head.init(keys[len(self.segs) + i], taps[i])
+            params[f"aux{i}"] = p
+            if s:
+                state[f"aux{i}"] = s
+        return params, state, shape
+
+    def _run_trunk(self, params, state, x, *, train, rng):
+        new_state = dict(state)
+        rngs = (
+            jax.random.split(rng, len(self.segs))
+            if rng is not None
+            else [None] * len(self.segs)
+        )
+        taps = []
+        for i, seg in enumerate(self.segs):
+            x, s = seg.apply(
+                params[f"seg{i}"], state.get(f"seg{i}", {}), x,
+                train=train, rng=rngs[i],
+            )
+            if s:
+                new_state[f"seg{i}"] = s
+            taps.append(x)
+        return x, taps, new_state
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        out, _, new_state = self._run_trunk(params, state, x, train=train, rng=rng)
+        return out, new_state
+
+    def apply_with_aux(self, params, state, x, *, train=False, rng=None):
+        rng, aux_rng = (
+            jax.random.split(rng) if rng is not None else (None, None)
+        )
+        out, taps, new_state = self._run_trunk(
+            params, state, x, train=train, rng=rng
+        )
+        aux_rngs = (
+            jax.random.split(aux_rng, len(self.heads))
+            if aux_rng is not None
+            else [None] * len(self.heads)
+        )
+        aux_logits = []
+        for i, head in enumerate(self.heads):
+            a, s = head.apply(
+                params[f"aux{i}"], state.get(f"aux{i}", {}), taps[i],
+                train=train, rng=aux_rngs[i],
+            )
+            if s:
+                new_state[f"aux{i}"] = s
+            aux_logits.append(a)
+        return (out, tuple(aux_logits)), new_state
+
+
 class GoogLeNet(SupervisedModel):
     default_config = {
         "batch_size": 32,
@@ -101,16 +182,39 @@ class GoogLeNet(SupervisedModel):
         "n_classes": 1000,
         "lrn": True,
         "dropout": 0.4,
+        "aux": False,  # paper §5 auxiliary classifiers (train-time only)
     }
 
     def build_data(self):
         return ImageNetData(self.config)
 
-    def build_net(self):
+    def _aux_head(self) -> L.Sequential:
+        """Paper §5 head: avgpool 5x5/3, 1x1x128 conv, FC-1024, drop 0.7, FC.
+
+        On inputs too small for a 5x5 valid pool at the tap (tests run tiny
+        images; the tap sits at image_size/16) the pool degrades to global.
+        """
         cfg = self.config
         relu = L.Activation("relu")
+        tap_hw = cfg["image_size"] // 16
+        pool = (L.AvgPool(5, stride=3) if tap_hw >= 5 else L.GlobalAvgPool())
+        return L.Sequential((
+            pool,
+            L.Conv2D(128, 1) if tap_hw >= 5 else L.Dense(128),
+            relu,
+            L.Flatten(),
+            L.Dense(1024),
+            relu,
+            L.Dropout(0.7),
+            L.Dense(cfg["n_classes"], w_init=init_lib.glorot_normal),
+        ))
+
+    def build_net(self):
+        cfg = self.config
+        self.aux = bool(cfg["aux"])
+        relu = L.Activation("relu")
         maybe_lrn = [L.LRN(size=5)] if cfg["lrn"] else []
-        layers: list[L.Layer] = [
+        stem: list[L.Layer] = [
             L.Conv2D(64, 7, stride=2, padding=3),
             relu,
             L.MaxPool(3, stride=2, padding="SAME"),
@@ -122,15 +226,42 @@ class GoogLeNet(SupervisedModel):
             *maybe_lrn,
             L.MaxPool(3, stride=2, padding="SAME"),
         ]
-        for item in _PLAN:
-            if item == "P":
-                layers.append(L.MaxPool(3, stride=2, padding="SAME"))
-            else:
-                layers.append(_Inception(item[1]))
-        layers += [
+        head = [
             L.GlobalAvgPool(),
             L.Dropout(cfg["dropout"]),
             L.Dense(cfg["n_classes"], w_init=init_lib.glorot_normal),
         ]
+        # trunk segments split at the aux taps: [stem..4a], [4b..4d],
+        # [4e..logits]
+        segs: list[list[L.Layer]] = [stem, [], []]
+        seg = 0
+        for item in _PLAN:
+            if item == "P":
+                segs[seg].append(L.MaxPool(3, stride=2, padding="SAME"))
+            else:
+                segs[seg].append(_Inception(item[1]))
+                if item[0] == "4a":
+                    seg = 1
+                elif item[0] == "4d":
+                    seg = 2
+        segs[2] += head
         s = cfg["image_size"]
-        return L.Sequential(layers), (s, s, 3)
+        if not self.aux:
+            # flat Sequential: the single fused path, and the param-tree
+            # layout aux=False checkpoints have always had
+            return L.Sequential(tuple(segs[0] + segs[1] + segs[2])), (s, s, 3)
+        net = _TrunkWithTaps(
+            segs=tuple(L.Sequential(tuple(s)) for s in segs),
+            heads=(self._aux_head(), self._aux_head()),
+        )
+        return net, (s, s, 3)
+
+    def apply_net(self, params, state, x, *, train, rng):
+        # paper §5: aux losses join at weight 0.3 (loss_fn's
+        # aux_loss_weight) during training only; eval runs the main path
+        if not (train and self.aux):
+            return super().apply_net(params, state, x, train=train, rng=rng)
+        (logits, aux_logits), new_state = self.net.apply_with_aux(
+            params, state, x, train=train, rng=rng
+        )
+        return logits, aux_logits, new_state

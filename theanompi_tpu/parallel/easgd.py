@@ -66,16 +66,18 @@ def elastic_exchange(params, center, alpha, axis_name=DATA_AXIS):
 class EASGDTrainer(BaseTrainer):
     """τ local steps per worker, then a collective elastic exchange.
 
-    ``alpha`` defaults to the EASGD paper's stable choice ``0.9/(τ·n)``
-    scaled rule of thumb — here simply ``0.5/n`` matching the reference's
-    default moving rate divided across the synchronous round.
+    ``alpha`` defaults to ``0.9 / n_workers``: the EASGD paper (Zhang,
+    Choromanska & LeCun, NeurIPS 2015, §5) parameterizes the elastic force
+    as ``β = p·α`` and uses ``β = 0.9`` in all experiments, giving
+    ``α = 0.9/p`` for ``p`` workers.  (The reference's own default is
+    unrecoverable — its mount is empty — so the paper is the source.)
     """
 
     def __init__(self, model, mesh=None, tau: int = 4,
                  alpha: float | None = None, **kwargs):
         super().__init__(model, mesh=mesh, **kwargs)
         self.tau = tau
-        self.alpha = alpha if alpha is not None else 0.5 / self.n_workers
+        self.alpha = alpha if alpha is not None else 0.9 / self.n_workers
         self.center = None
         self._exchange_fn = None
         self._consensus_state_fn = None
@@ -130,6 +132,9 @@ class EASGDTrainer(BaseTrainer):
             self.recorder.start("comm")
             self.params, self.center = self._exchange_fn(self.params, self.center)
             self.recorder.end("comm")
+
+    def warmup_exchange(self) -> None:
+        self.params, self.center = self._exchange_fn(self.params, self.center)
 
     def eval_args(self):
         """Validate with the center parameters (the reference server's job)."""

@@ -94,7 +94,9 @@ class GOSGDTrainer(BaseTrainer):
         self.weights = None
         self._gossip_fn = None
         self._consensus_fn = None
-        self._host_rng = np.random.RandomState(self.seed + 17)
+        # seeded in init_state so warmup()'s reset restores the full
+        # deterministic schedule (push draws + ring shifts), not just params
+        self._host_rng = None
 
     def compile_iter_fns(self) -> None:
         local_step = make_local_step(
@@ -157,6 +159,7 @@ class GOSGDTrainer(BaseTrainer):
         self.weights = jax.device_put(
             np.full((n,), 1.0 / n, np.float32), NamedSharding(self.mesh, P(DATA_AXIS))
         )
+        self._host_rng = np.random.RandomState(self.seed + 17)
 
     def post_step(self) -> None:
         n = self.n_workers
@@ -175,6 +178,16 @@ class GOSGDTrainer(BaseTrainer):
             jnp.int32(shift),
         )
         self.recorder.end("comm")
+
+    def warmup_exchange(self) -> None:
+        if self.n_workers == 1:
+            return
+        # all-zero push: executes the compiled gossip round as a no-op merge
+        # (shift is traced, so this one call covers every future draw)
+        self.params, self.weights = self._gossip_fn(
+            self.params, self.weights,
+            jnp.zeros((self.n_workers,), jnp.float32), jnp.int32(1),
+        )
 
     def eval_args(self):
         """Validate with the weighted consensus of all workers."""

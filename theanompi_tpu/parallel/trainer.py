@@ -185,6 +185,39 @@ class BaseTrainer:
     def post_step(self) -> None:
         """Periodic host-driven exchange hook (EASGD/GOSGD)."""
 
+    def warmup_exchange(self) -> None:
+        """Execute the rule's periodic-exchange compiled path once (jit is
+        lazy; ``post_step`` may not fire it on the first iterations)."""
+
+    def warmup(self) -> None:
+        """Run every compiled path once, then reset to a fresh init.
+
+        Timing harnesses (bench, rulecomp) call this so their measured
+        window excludes XLA compilation: jit compiles at first call, not at
+        ``compile_iter_fns`` (which only builds the jit wrappers).
+        """
+        batch = next(iter(
+            self.model.data.train_batches(self.global_batch, 0, seed=self.seed)
+        ))
+        self.train_iter(batch, lr=self.model.adjust_hyperp(0))
+        self.warmup_exchange()
+        # one val batch compiles the eval + consensus paths; a full
+        # validate() would walk the whole val set untimed but for real
+        vb = min(self.global_batch, self.model.data.n_val)
+        vb -= vb % self.n_workers  # same divisibility rule as validate()
+        if vb:
+            vbatch = next(iter(self.model.data.val_batches(vb)), None)
+            if vbatch is not None:
+                self.val_iter(vbatch)
+        self.init_state()
+        self.iteration = 0
+        self.epoch = 0
+        self.recorder = Recorder(
+            print_freq=self.recorder.print_freq,
+            save_dir=self.recorder.save_dir,
+            verbose=self.recorder.verbose,
+        )
+
     def checkpoint_trees(self) -> dict:
         """Named pytrees a checkpoint must capture (rules add extras)."""
         return {
@@ -278,7 +311,13 @@ class BaseTrainer:
         return means
 
     # -- full run (reference *_worker.run) -----------------------------------
-    def run(self):
+    def run(self, stop=None):
+        """Train to completion.
+
+        ``stop``: optional ``(epoch, val_metrics) -> bool`` checked after each
+        epoch's validation — a True ends training early (used by the
+        rule-comparison harness for train-to-target runs).
+        """
         if self._step_fn is None:
             self.compile_iter_fns()
         if self.params is None:
@@ -319,9 +358,11 @@ class BaseTrainer:
                 close = getattr(batches, "close", None)
                 if close is not None:
                     close()
-            self.validate(epoch)
+            val = self.validate(epoch)
             self.save_checkpoint(epoch)
             self.epoch = epoch + 1  # resume point: next epoch, not this one
+            if stop is not None and stop(epoch, val):
+                break
         self.recorder.save()
         model.cleanup()
         return self.recorder

@@ -1,0 +1,153 @@
+"""Rule-value comparison: BSP vs EASGD vs GOSGD trained to a target.
+
+The reference's selling point (SURVEY.md §6, paper claim) is that EASGD is
+wall-clock competitive with — or better than — BSP at equal accuracy.  Round
+1 verified the rules' *mechanics* only; this harness measures their *value*:
+train the same model from the same init under each rule on the same mesh,
+stop when validation error first reaches a target (or at ``max_epochs``),
+and record steps, epochs, and wall-clock to target.
+
+Usage (also exposed as ``python -m theanompi_tpu.utils.rulecomp``)::
+
+    from theanompi_tpu.utils.rulecomp import compare_rules
+    results = compare_rules(devices=8, target_error=0.80,
+                            out_path="rulecomp.json")
+
+Each result row::
+
+    {"rule": "easgd_tau4", "reached": true, "epochs": 3, "steps": 96,
+     "wall_s": 12.4, "best_val_error": 0.71, "val_error_curve": [...]}
+
+Compile time is excluded honestly: jit compiles at first *call*, not at
+``compile_iter_fns``, so each run executes every compiled path once via
+``trainer.warmup()`` (train step, the rule's exchange, eval), resets to a
+fresh init, and only then starts the clock.  The virtual-CPU mesh measures
+*algorithmic* value (steps/epochs to target); on real chips the same
+harness measures comm cost too.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any
+
+DEFAULT_MODEL_CONFIG = {
+    "depth": 10,
+    "widen": 1,
+    "batch_size": 8,
+    "image_size": 16,
+    "n_train": 512,
+    "n_val": 128,
+    "precision": "fp32",
+    "lr": 0.05,
+}
+
+
+def default_rulesets() -> list[tuple[str, str, dict]]:
+    """-> [(name, rule_class_name, rule_config)] — the VERDICT #5 grid."""
+    return [
+        ("bsp", "BSP", {}),
+        ("easgd_tau1", "EASGD", {"tau": 1}),
+        ("easgd_tau4", "EASGD", {"tau": 4}),
+        ("easgd_tau16", "EASGD", {"tau": 16}),
+        ("gosgd", "GOSGD", {}),
+    ]
+
+
+def run_to_target(rule, *, devices, model_config: dict, target_error: float,
+                  max_epochs: int, modelfile: str, modelclass: str) -> dict:
+    """Train one rule until val error <= target (or max_epochs); -> result row."""
+    rule.init(devices=devices, modelfile=modelfile, modelclass=modelclass,
+              model_config={**model_config, "n_epochs": max_epochs})
+    rule.trainer.warmup()  # compile everything outside the timed window
+    hit: dict[str, Any] = {}
+
+    def stop(epoch: int, val: dict) -> bool:
+        err = val.get("error")
+        if err is not None and err <= target_error and "epoch" not in hit:
+            hit["epoch"] = epoch
+            hit["steps"] = rule.trainer.iteration
+        return "epoch" in hit
+
+    t0 = time.perf_counter()
+    rec = rule.trainer.run(stop=stop)
+    wall = time.perf_counter() - t0
+    curve = [float(e) for e in rec.val_history.get("error", [])]
+    return {
+        "reached": "epoch" in hit,
+        "epochs_to_target": hit.get("epoch"),
+        "steps_to_target": hit.get("steps"),
+        "epochs_run": len(curve),
+        "steps_run": rule.trainer.iteration,
+        "wall_s": round(wall, 3),
+        "best_val_error": min(curve) if curve else None,
+        "val_error_curve": curve,
+    }
+
+
+def compare_rules(devices=8, model_config: dict | None = None,
+                  target_error: float = 0.5, max_epochs: int = 8,
+                  rules: list[tuple[str, str, dict]] | None = None,
+                  modelfile: str = "theanompi_tpu.models.wide_resnet",
+                  modelclass: str = "WideResNet",
+                  out_path: str | None = None,
+                  verbose: bool = True) -> dict:
+    """Run the full comparison grid; -> artifact dict (optionally written)."""
+    import theanompi_tpu as tm
+
+    model_config = {**DEFAULT_MODEL_CONFIG, **(model_config or {}),
+                    "verbose": False}
+    rows = []
+    for name, cls_name, cfg in (rules or default_rulesets()):
+        rule_cls = getattr(tm, cls_name)
+        rule = rule_cls(config={**cfg, "seed": 0, "verbose": False})
+        row = run_to_target(
+            rule, devices=devices, model_config=model_config,
+            target_error=target_error, max_epochs=max_epochs,
+            modelfile=modelfile, modelclass=modelclass,
+        )
+        row = {"rule": name, "rule_class": cls_name, "rule_config": cfg, **row}
+        rows.append(row)
+        if verbose:
+            print(json.dumps(row), flush=True)
+    artifact = {
+        "model": f"{modelfile}.{modelclass}",
+        "model_config": {k: v for k, v in model_config.items()},
+        "devices": devices if isinstance(devices, int) else len(devices),
+        "target_error": target_error,
+        "max_epochs": max_epochs,
+        "results": rows,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+    return artifact
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--target-error", type=float, default=0.5)
+    p.add_argument("--max-epochs", type=int, default=8)
+    p.add_argument("--out", default="rulecomp.json")
+    p.add_argument("--force-host-devices", type=int, default=None,
+                   help="fake N virtual CPU devices (env vars are too late "
+                        "on images whose sitecustomize imports jax)")
+    a = p.parse_args(argv)
+    if a.force_host_devices:
+        from theanompi_tpu.parallel.mesh import force_host_devices
+
+        force_host_devices(a.force_host_devices)
+    art = compare_rules(devices=a.devices, target_error=a.target_error,
+                        max_epochs=a.max_epochs, out_path=a.out)
+    reached = [r for r in art["results"] if r["reached"]]
+    print(json.dumps({
+        "reached": len(reached), "of": len(art["results"]), "out": a.out
+    }))
+
+
+if __name__ == "__main__":
+    main()
