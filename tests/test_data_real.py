@@ -1,0 +1,140 @@
+"""Real-data branches (VERDICT #7): the paths synthetic-only CI never hit.
+
+Covers CIFAR-10 ``.npz`` loading, PTB text-file loading, and the
+``.hkl``-tree converter (with a stubbed ``hickle`` module — the real one is
+not in this image), including the CHW→HWC transpose where a silent layout
+bug would live.
+"""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+
+def test_cifar10_npz_branch(tmp_path):
+    rng = np.random.RandomState(0)
+    xt = rng.randint(0, 256, (64, 32, 32, 3)).astype(np.uint8)
+    yt = rng.randint(0, 10, (64, 1))  # shaped (N,1) as common dumps are
+    xv = rng.randint(0, 256, (32, 32, 32, 3)).astype(np.uint8)
+    yv = rng.randint(0, 10, (32, 1))
+    path = tmp_path / "cifar10.npz"
+    np.savez(path, x_train=xt, y_train=yt, x_test=xv, y_test=yv)
+
+    from theanompi_tpu.models.data.cifar10 import MEAN, STD, Cifar10Data
+
+    data = Cifar10Data({"data_path": str(path), "augment": False})
+    assert not data.synthetic
+    assert data.n_train == 64 and data.n_val == 32
+    assert data.n_classes == 10
+    # labels flattened to rank 1 int
+    assert data.y_train.shape == (64,) and data.y_train.dtype == np.int32
+    # normalization: x = (raw/255 - MEAN)/STD, exactly
+    expect = (xt[0].astype(np.float32) / 255.0 - MEAN) / STD
+    np.testing.assert_allclose(data.x_train[0], expect, rtol=1e-6)
+    batch = next(iter(data.train_batches(8, epoch=0, seed=0)))
+    assert batch["x"].shape == (8, 32, 32, 3)
+    assert batch["y"].shape == (8,)
+
+
+def test_cifar10_npz_tanh_normalize(tmp_path):
+    xt = np.full((8, 32, 32, 3), 255, np.uint8)
+    y = np.zeros((8,), np.int64)
+    path = tmp_path / "c.npz"
+    np.savez(path, x_train=xt, y_train=y, x_test=xt, y_test=y)
+
+    from theanompi_tpu.models.data.cifar10 import Cifar10Data
+
+    data = Cifar10Data({"data_path": str(path), "augment": False,
+                        "normalize": "tanh"})
+    # GAN mode maps [0,1] -> [-1,1]: 255 -> 1.0
+    np.testing.assert_allclose(data.x_train, 1.0, atol=1e-6)
+
+
+def test_ptb_text_branch(tmp_path):
+    train_text = "the cat sat on the mat . " * 40
+    val_text = "the dog sat on the unseen mat . " * 10
+    (tmp_path / "ptb.train.txt").write_text(train_text)
+    (tmp_path / "ptb.valid.txt").write_text(val_text)
+
+    from theanompi_tpu.models.lstm import PTBData
+
+    data = PTBData({"data_path": str(tmp_path), "seq_len": 6})
+    assert not data.synthetic
+    # vocab: 6 train words + <unk2>
+    assert data.vocab == 7
+    unk = data.vocab - 1
+    # "dog"/"unseen" are not in train vocab -> mapped to unk in val
+    assert "dog" not in data.word_to_id
+    val_ids = data._val_seqs.reshape(-1)
+    assert (val_ids == unk).any()
+    # train ids never unk, and round-trip through the vocab mapping
+    train_ids = data._train_seqs.reshape(-1)
+    assert (train_ids != unk).all()
+    assert train_ids.max() < data.vocab
+    # sequences chopped to seq_len+1 and batched as (x, y)=(t[:-1], t[1:])
+    assert data._train_seqs.shape[1] == 7
+    b = next(iter(data.train_batches(4, epoch=0)))
+    assert b["x"].shape == (4, 6) and b["y"].shape == (4, 6)
+    # y is x shifted by one within the same chopped window
+    order_row = b["x"][0]
+    assert b["y"][0][0] != order_row[0] or len(set(order_row.tolist())) == 1
+
+
+def test_ptb_text_trains_one_step(tmp_path):
+    (tmp_path / "ptb.train.txt").write_text("a b c d e f g h " * 64)
+    (tmp_path / "ptb.valid.txt").write_text("a b c d e f g h " * 16)
+    import jax
+
+    from theanompi_tpu.models.lstm import LSTM
+    from theanompi_tpu.parallel.bsp import BSPTrainer
+    from theanompi_tpu.parallel.mesh import make_mesh
+
+    model = LSTM({"data_path": str(tmp_path), "seq_len": 7, "batch_size": 4,
+                  "hidden": 16, "embed_dim": 16, "n_layers": 1,
+                  "n_epochs": 1, "precision": "fp32", "dropout": 0.0})
+    t = BSPTrainer(model, mesh=make_mesh(n_data=1, devices=jax.devices()[:1]))
+    t.compile_iter_fns()
+    t.init_state()
+    batch = next(iter(model.data.train_batches(t.global_batch, 0, seed=0)))
+    m = t.train_iter(batch, lr=0.5)
+    assert np.isfinite(float(m["cost"]))
+
+
+def test_convert_hkl_tree_transposes_chw(tmp_path, monkeypatch):
+    """Stubbed hickle: the converter must emit uint8 HWC .npy shards."""
+    src = tmp_path / "hkl"
+    dst = tmp_path / "npy"
+    src.mkdir()
+    rng = np.random.RandomState(3)
+    # reference-era layout: (N, C, H, W) float batches in .hkl files
+    shards = {
+        "0000.hkl": rng.randint(0, 256, (4, 3, 8, 8)).astype(np.float32),
+        "0001.hkl": rng.randint(0, 256, (4, 3, 8, 8)).astype(np.float32),
+    }
+    for name, arr in shards.items():
+        (src / name).write_bytes(b"hkl-stub")
+
+    stub = types.ModuleType("hickle")
+    stub.load = lambda p: shards[p.split("/")[-1]]
+    monkeypatch.setitem(sys.modules, "hickle", stub)
+
+    from theanompi_tpu.models.data.imagenet import convert_hkl_tree
+
+    convert_hkl_tree(str(src), str(dst))
+    out0 = np.load(dst / "x_0000.npy")
+    assert out0.shape == (4, 8, 8, 3), "CHW -> HWC transpose missing"
+    assert out0.dtype == np.uint8
+    np.testing.assert_array_equal(
+        out0, shards["0000.hkl"].transpose(0, 2, 3, 1).astype(np.uint8)
+    )
+    assert sorted(p.name for p in dst.iterdir()) == ["x_0000.npy", "x_0001.npy"]
+
+
+def test_convert_hkl_tree_without_hickle_raises(tmp_path, monkeypatch):
+    monkeypatch.setitem(sys.modules, "hickle", None)  # force ImportError
+    from theanompi_tpu.models.data.imagenet import convert_hkl_tree
+
+    with pytest.raises(ImportError, match="hickle"):
+        convert_hkl_tree(str(tmp_path), str(tmp_path / "out"))

@@ -15,8 +15,15 @@ Usage (also exposed as ``python -m theanompi_tpu.utils.rulecomp``)::
 
 Each result row::
 
-    {"rule": "easgd_tau4", "reached": true, "epochs": 3, "steps": 96,
-     "wall_s": 12.4, "best_val_error": 0.71, "val_error_curve": [...]}
+    {"rule": "easgd_tau4", "reached": true, "epochs_to_target": 3,
+     "steps_to_target": 96, "epochs_run": 4, "steps_run": 128,
+     "wall_s": 12.4, "effective_lr": 0.4, "best_val_error": 0.71,
+     "val_error_curve": [...]}
+
+``effective_lr`` is the model's base LR *after* the rule's hooks ran —
+EASGD's reference ``scale_lr`` hook multiplies LR by the worker count by
+default, so EASGD rows train hotter than BSP/GOSGD at the same config;
+the field makes that confound visible in the artifact.
 
 Compile time is excluded honestly: jit compiles at first *call*, not at
 ``compile_iter_fns``, so each run executes every compiled path once via
@@ -76,6 +83,8 @@ def run_to_target(rule, *, devices, model_config: dict, target_error: float,
     curve = [float(e) for e in rec.val_history.get("error", [])]
     return {
         "reached": "epoch" in hit,
+        # post-hook LR: EASGD's scale_lr multiplies by n_workers by default
+        "effective_lr": rule.trainer.model.config.get("lr"),
         "epochs_to_target": hit.get("epoch"),
         "steps_to_target": hit.get("steps"),
         "epochs_run": len(curve),
