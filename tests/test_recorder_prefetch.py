@@ -62,6 +62,25 @@ def test_starved_pipeline_reports_wait():
     assert sum(waits) > 0.3, f"starved pipeline hid its stall: {waits}"
 
 
+def test_profile_window_captures_trace(tmp_path, mesh8):
+    """profile_dir + a [start, stop) window must produce a device trace on
+    disk and switch tracing off afterwards (SURVEY.md §5 tracing row)."""
+    import os
+
+    from theanompi_tpu import BSP
+
+    rule = BSP(config={"verbose": False, "profile_dir": str(tmp_path),
+                       "profile_window": (1, 2)})
+    rule.init(devices=8, model_config={
+        "depth": 10, "widen": 1, "batch_size": 2, "image_size": 8,
+        "n_train": 64, "n_val": 16, "n_epochs": 1, "precision": "fp32",
+        "verbose": False})
+    rule.wait()
+    assert not rule.trainer._profiling
+    found = [os.path.join(r, f) for r, _, fs in os.walk(tmp_path) for f in fs]
+    assert found, "no trace files written by the profile window"
+
+
 def test_fed_pipeline_wait_is_small():
     """With an instant loader, wait must be a small share of calc."""
     rec = _run_with_loader_delay(0.0)

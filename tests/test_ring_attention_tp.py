@@ -47,6 +47,34 @@ def test_blockwise_matches_reference(causal):
                                rtol=2e-4, atol=2e-5)
 
 
+def test_block_attend_fully_masked_block_first():
+    """A fully-masked block hitting the -1e30-init accumulator must add NO
+    mass (the p=exp(0)=1 hazard): accumulation is order-independent, no
+    diagonal-first invariant required."""
+    from theanompi_tpu.parallel.ring_attention import _block_attend
+
+    r = np.random.RandomState(2)
+    b, t, h, d = 1, 4, 1, 8
+    q, k1, v1, k2, v2 = (
+        jnp.asarray(r.randn(b, t, h, d).astype(np.float32)) for _ in range(5)
+    )
+    m0 = jnp.full((b, h, t), -1e30, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    acc0 = jnp.zeros((b, t, h, d), jnp.float32)
+    none_visible = jnp.zeros((1, 1, t, t), bool)
+    all_visible = jnp.ones((1, 1, t, t), bool)
+
+    # masked block FIRST, then the visible block
+    m, l, acc = _block_attend(q, k1, v1, m0, l0, acc0, none_visible)
+    assert float(jnp.max(l)) == 0.0, "fully-masked block accumulated mass"
+    m, l, acc = _block_attend(q, k2, v2, m, l, acc, all_visible)
+    got = np.asarray(acc / l.transpose(0, 2, 1)[..., None])
+    want = _reference_attention(
+        np.asarray(q), np.asarray(k2), np.asarray(v2), causal=False
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_matches_full(causal):
     """Ring over 8 seq shards == full attention over the whole sequence."""

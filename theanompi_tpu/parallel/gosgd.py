@@ -13,7 +13,17 @@ collective round applies every push at once: pusher ``i``'s target is
 reference's per-worker marginal — and the routing is ``k`` repetitions of the
 single-hop ring ``ppermute`` (a ``fori_loop`` with a traced trip count), so a
 round costs at most ``n-1`` ICI hops and needs no data-dependent permutation.
-Weight conservation (Σw = 1) holds by construction.  Semantics changed:
+
+Routing-cost tradeoff (deliberate): a shift of ``k`` moves the whole
+parameter tree ``k`` sequential hops — O(n) ICI latency worst-case.  The
+alternative, one compiled program per shift (each a single direct
+``ppermute`` by ``k``), costs one hop per round but ``n-1`` compiled
+variants (compile time and HBM for executables scale with n) and loses the
+single-trace property.  At gossip's design point — exchanges are rare
+(``p_push ~ 1/n``) and overlap compute — hop latency is not the bottleneck,
+so one traced program wins; revisit only if profiles show gossip rounds on
+the critical path at pod scale.  Weight conservation (Σw = 1) holds by
+construction.  Semantics changed:
 pushes land at round boundaries instead of asynchronously mid-step, and
 within one round targets are a cyclic shift (no collisions) rather than
 jointly-iid — the per-worker target distribution is unchanged.

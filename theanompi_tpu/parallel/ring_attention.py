@@ -46,6 +46,12 @@ def _block_attend(q, k, v, m_prev, l_prev, acc, mask=None):
     # renormalize previous accumulation to the new max
     correction = jnp.exp(m_prev - m_new)
     p = jnp.exp(s - m_new[..., None])  # [B, H, Tq, Tk]
+    if mask is not None:
+        # a fully-masked row while m is still at the -1e30 init would give
+        # p = exp(s - m_new) = exp(0) = 1 per entry — bogus mass.  Zeroing
+        # masked positions makes accumulation order-independent (no
+        # "diagonal block first" invariant needed); XLA fuses the select.
+        p = jnp.where(mask, p, 0.0)
     l_new = l_prev * correction + jnp.sum(p, axis=-1)
     pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     acc_new = acc * correction.transpose(0, 2, 1)[..., None] + pv

@@ -148,7 +148,9 @@ class BaseTrainer:
 
     def __init__(self, model, mesh=None, recorder: Recorder | None = None,
                  seed: int = 0, prefetch_depth: int = 2,
-                 checkpoint_dir: str | None = None, checkpoint_keep: int = 3):
+                 checkpoint_dir: str | None = None, checkpoint_keep: int = 3,
+                 profile_dir: str | None = None,
+                 profile_window: tuple[int, int] = (10, 20)):
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh(n_data=1)
         self.n_workers = self.mesh.shape[DATA_AXIS]
@@ -170,6 +172,11 @@ class BaseTrainer:
         self.opt_state = None
         self.epoch = 0
         self.iteration = 0
+        # SURVEY.md §5 tracing row: a bounded jax.profiler window
+        # (TensorBoard-viewable device trace), off unless profile_dir is set
+        self.profile_dir = profile_dir
+        self.profile_window = profile_window
+        self._profiling = False
 
     # -- subclass surface ----------------------------------------------------
     def compile_iter_fns(self) -> None:
@@ -252,8 +259,35 @@ class BaseTrainer:
                   f"(iteration {self.iteration})", flush=True)
         return True
 
+    # -- profiling (SURVEY.md §5: jax.profiler traces) -----------------------
+    def _profile_tick(self) -> None:
+        """Start/stop the device trace at the configured iteration window.
+
+        The window is [start, stop) in global iterations; steps inside it are
+        captured to ``profile_dir`` (open with TensorBoard's profile plugin
+        or Perfetto).  A bounded window, not whole-run tracing: traces are
+        huge and perturb timing.  Stop fences on the params so the trace
+        includes the full device execution of the last windowed step.
+        """
+        if self.profile_dir is None:
+            return
+        start, stop = self.profile_window
+        # range membership, not equality: a resumed run (try_resume sets
+        # iteration past `start`) must still trace if it's inside the window
+        if not self._profiling and start <= self.iteration < stop:
+            jax.profiler.start_trace(self.profile_dir)
+            self._profiling = True
+        elif self._profiling and self.iteration >= stop:
+            self._profile_stop()
+
+    def _profile_stop(self) -> None:
+        jax.block_until_ready(jax.tree.leaves(self.params))
+        jax.profiler.stop_trace()
+        self._profiling = False
+
     # -- iteration (reference train_iter/val_iter) ---------------------------
     def train_iter(self, batch: dict, lr: float, recorder: Recorder | None = None):
+        self._profile_tick()
         r = recorder or self.recorder
         r.start("wait")
         # already-placed batches (prefetch path) pass through device_put free
@@ -363,6 +397,8 @@ class BaseTrainer:
             self.epoch = epoch + 1  # resume point: next epoch, not this one
             if stop is not None and stop(epoch, val):
                 break
+        if self._profiling:  # window ran past the end of training
+            self._profile_stop()
         self.recorder.save()
         model.cleanup()
         return self.recorder
@@ -399,6 +435,8 @@ class Rule:
             prefetch_depth=self.config.get("prefetch", 2),
             checkpoint_dir=self.config.get("checkpoint_dir"),
             checkpoint_keep=self.config.get("checkpoint_keep", 3),
+            profile_dir=self.config.get("profile_dir"),
+            profile_window=tuple(self.config.get("profile_window", (10, 20))),
         )
 
     def adjust_model_config(self, model_config: dict, n_workers: int) -> None:
