@@ -1,0 +1,174 @@
+"""Pallas flash-attention kernel: numerics vs the XLA reference.
+
+The kernel runs in interpreter mode on the CPU mesh (same code path the
+Mosaic compiler takes on TPU).  Forward is checked against naive softmax
+attention; the custom-VJP backward against autodiff of the reference.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.ops.pallas_attention import (
+    flash_attention,
+    flash_attention_supported,
+)
+
+
+def _reference(q, k, v, causal):
+    b, t, h, d = q.shape
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _rand(shape, seed):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_forward_matches_reference(causal):
+    b, t, h, d = 2, 256, 2, 64
+    q, k, v = (jnp.asarray(_rand((b, t, h, d), i)) for i in range(3))
+    out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128,
+                          interpret=True)
+    want = _reference(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_match_reference(causal):
+    b, t, h, d = 1, 128, 2, 64
+    q, k, v = (jnp.asarray(_rand((b, t, h, d), 10 + i)) for i in range(3))
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64,
+                            interpret=True)
+        return jnp.sum(jnp.sin(o))  # non-trivial cotangent
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(_reference(q, k, v, causal)))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), rtol=5e-4, atol=5e-5,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_flash_uneven_blocks():
+    """block_q != block_k and T a multiple of both."""
+    b, t, h, d = 1, 256, 1, 64
+    q, k, v = (jnp.asarray(_rand((b, t, h, d), 20 + i)) for i in range(3))
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=128,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_reference(q, k, v, True)),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_supported_gate():
+    assert flash_attention_supported(256, 64)
+    assert flash_attention_supported(512, 128)
+    assert not flash_attention_supported(100, 64)   # ragged T
+    assert not flash_attention_supported(256, 8)    # tiny head dim
+    with pytest.raises(ValueError, match="unsupported shape"):
+        flash_attention(jnp.zeros((1, 100, 1, 8)), jnp.zeros((1, 100, 1, 8)),
+                        jnp.zeros((1, 100, 1, 8)))
+
+
+def test_mha_forced_pallas_matches_blockwise(monkeypatch):
+    """impl='pallas' must actually take the kernel path (call-counted) and
+    match the forced blockwise path on the same params."""
+    import theanompi_tpu.ops.pallas_attention as pa
+    from theanompi_tpu.ops.attention import MultiHeadAttention
+
+    calls = []
+    real = pa.flash_attention
+    monkeypatch.setattr(pa, "flash_attention",
+                        lambda *a, **kw: calls.append(1) or real(*a, **kw))
+
+    b, t, dim, heads = 2, 128, 128, 2  # head_dim 64 -> pallas-eligible
+    x = jnp.asarray(_rand((b, t, dim), 30))
+    pallas = MultiHeadAttention(dim, heads, causal=True, impl="pallas")
+    blockwise = MultiHeadAttention(dim, heads, causal=True, impl="blockwise")
+    params, _, _ = pallas.init(jax.random.PRNGKey(0), (t, dim))
+    y_pallas, _ = pallas.apply(params, {}, x)
+    assert calls, "impl='pallas' did not reach the flash kernel"
+    n_after_pallas = len(calls)
+    y_block, _ = blockwise.apply(params, {}, x)
+    assert len(calls) == n_after_pallas, "blockwise path hit the kernel"
+    np.testing.assert_allclose(np.asarray(y_pallas), np.asarray(y_block),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_mha_auto_gate_policy(monkeypatch):
+    """auto = kernel only for (inference AND tpu AND supported shapes)."""
+    import theanompi_tpu.ops.pallas_attention as pa
+    from theanompi_tpu.ops import attention as attn_mod
+    from theanompi_tpu.ops.attention import MultiHeadAttention
+
+    calls = []
+    real = pa.flash_attention
+    monkeypatch.setattr(pa, "flash_attention",
+                        lambda *a, **kw: calls.append(1) or real(*a, **kw))
+
+    b, t, dim, heads = 1, 128, 128, 2
+    x = jnp.asarray(_rand((b, t, dim), 31))
+    auto = MultiHeadAttention(dim, heads, causal=True, impl="auto")
+    params, _, _ = auto.init(jax.random.PRNGKey(0), (t, dim))
+
+    # off-TPU (this suite runs on the CPU mesh): auto must NOT use pallas
+    auto.apply(params, {}, x, train=False)
+    assert not calls, "auto used the pallas interpreter off-TPU"
+
+    # pretend we're on TPU: inference uses it, training does not
+    monkeypatch.setattr(attn_mod.jax, "default_backend", lambda: "tpu")
+    # interpret must still be forced: jax.default_backend is patched only
+    # in the attention module's view, but flash_attention's own auto-select
+    # would see the real backend; pass through a wrapper forcing interpret
+    monkeypatch.setattr(
+        pa, "flash_attention",
+        lambda q, k, v, **kw: calls.append(1) or real(
+            q, k, v, **{**kw, "interpret": True}),
+    )
+    auto.apply(params, {}, x, train=False)
+    assert calls, "auto skipped pallas for eligible TPU inference"
+    n = len(calls)
+    auto.apply(params, {}, x, train=True,
+               rng=jax.random.PRNGKey(0))
+    assert len(calls) == n, "auto used pallas for training"
+
+
+def test_mha_rejects_unknown_impl():
+    from theanompi_tpu.ops.attention import MultiHeadAttention
+
+    with pytest.raises(ValueError, match="impl"):
+        MultiHeadAttention(128, 2, impl="flash")
+
+
+def test_transformer_lm_trains_with_pallas_attention():
+    from theanompi_tpu.models.transformer_lm import TransformerLM
+    from theanompi_tpu.parallel.bsp import BSPTrainer
+    from theanompi_tpu.parallel.mesh import make_mesh
+
+    model = TransformerLM({
+        "batch_size": 2, "n_train": 64, "n_val": 32, "seq_len": 128,
+        "vocab": 64, "dim": 128, "heads": 2, "n_layers": 1,
+        "dropout": 0.0, "n_epochs": 1, "precision": "fp32",
+        "attn_impl": "pallas",
+    })
+    t = BSPTrainer(model, mesh=make_mesh(n_data=1, devices=jax.devices()[:1]))
+    t.compile_iter_fns()
+    t.init_state()
+    batch = next(iter(model.data.train_batches(t.global_batch, 0, seed=0)))
+    m = t.train_iter(batch, lr=1e-3)
+    assert np.isfinite(float(m["cost"]))

@@ -41,11 +41,13 @@ class _Block(L.Layer):
     dim: int
     heads: int
     dropout: float = 0.0
+    attn_impl: str = "auto"
 
     def _subs(self):
         return (
             ("ln1", L.LayerNorm()),
-            ("attn", MultiHeadAttention(self.dim, self.heads, causal=True)),
+            ("attn", MultiHeadAttention(self.dim, self.heads, causal=True,
+                                        impl=self.attn_impl)),
             ("ln2", L.LayerNorm()),
             ("up", ColumnParallelDense(4 * self.dim, w_init=init_lib.normal(0.02))),
             ("down", RowParallelDense(self.dim, w_init=init_lib.normal(0.02))),
@@ -100,6 +102,9 @@ class TransformerLM(SupervisedModel):
         "n_layers": 4,
         "dropout": 0.1,
         "seq_parallel": False,
+        # "auto": pallas flash attention when shapes allow (TPU-compiled,
+        # interpreted on CPU); "blockwise"/"pallas" force a path
+        "attn_impl": "auto",
     }
 
     def build_data(self):
@@ -113,7 +118,8 @@ class TransformerLM(SupervisedModel):
             PositionEmbedding(cfg["seq_len"], cfg["dim"]),
         ]
         for _ in range(cfg["n_layers"]):
-            layers.append(_Block(cfg["dim"], cfg["heads"], cfg["dropout"]))
+            layers.append(_Block(cfg["dim"], cfg["heads"], cfg["dropout"],
+                                 attn_impl=cfg["attn_impl"]))
         layers += [
             L.LayerNorm(),
             L.Dense(self.data.vocab, w_init=init_lib.glorot_normal),

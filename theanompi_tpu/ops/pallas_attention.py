@@ -1,0 +1,184 @@
+"""Fused flash-attention forward as a Pallas TPU kernel.
+
+The hot op of the transformer stack (SURVEY.md §5 long-context row), written
+for the hardware rather than left to XLA's generic lowering: one kernel
+instance owns a ``[block_q, d]`` query tile in VMEM and streams K/V tiles
+through the MXU with the online-softmax recurrence, so the ``[T, T]`` score
+matrix never exists in HBM.  Causal tiles above the diagonal are *skipped*
+(the loop bound shrinks per query tile), not just masked.
+
+Scope decisions:
+
+- **Forward-only kernel + analytic backward.**  The backward recomputes
+  scores from the saved (q, k, v, out) in plain XLA einsums — fwd saves
+  O(T·d), not O(T²).  Measured on TPU v5e (B8 T2048 H8 D64, bf16): fwd is
+  ~8% faster than the XLA blockwise path; the analytic bwd materializes
+  full scores and loses to XLA's scan-derived blockwise backward, so
+  ``MultiHeadAttention``'s ``auto`` policy uses this kernel for inference
+  only.  A pallas backward kernel is the known next step if training
+  attention ever dominates profiles.
+- **Shapes**: ``[B, T, H, D]`` like the rest of the stack; T must divide by
+  ``block_q``/``block_k`` (callers fall back to
+  :func:`...ring_attention.blockwise_attention` otherwise — see
+  ``flash_attention_supported``).
+- **interpret=True** runs the same kernel on CPU for tests; on TPU the
+  Mosaic compiler takes it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
+                block_q, block_k, seq_len):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)  # [block_q, d]
+    nk_total = seq_len // block_k
+    if causal:
+        # tiles fully above the diagonal contribute nothing: shrink the loop
+        nk = jnp.minimum(nk_total, ((qi + 1) * block_q + block_k - 1) // block_k)
+    else:
+        nk = nk_total
+
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, kb.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = q_pos >= k_pos
+            s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)  # exp(0)=1 hazard on masked rows
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[:, None] + jax.lax.dot_general(
+            p, vb.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc
+
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def _fwd_call(q, k, v, *, causal, block_q, block_k, interpret):
+    """q/k/v: [B, H, T, D] -> out [B,H,T,D].
+
+    No auxiliary log-sum-exp output: Mosaic requires output block shapes
+    whose trailing dims tile (8, 128), which a per-row [.., block_q] lse
+    violates; the backward recomputes lse from the scores it materializes
+    anyway, which costs one fused reduction."""
+    b, h, t, d = q.shape
+    scale = d ** -0.5
+    grid = (b, h, t // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_len=t,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, t, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, t, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    return _fwd_call(q, k, v, causal=causal, block_q=block_q,
+                     block_k=block_k, interpret=interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _fwd_call(q, k, v, causal=causal, block_q=block_q,
+                    block_k=block_k, interpret=interpret)
+    return out, (q, k, v, out)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, out = res
+    qf, kf, vf, of, gf = (x.astype(jnp.float32) for x in (q, k, v, out, g))
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        t = q.shape[2]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    # p = exp(s - lse): lse recomputed here (the kernel emits only `out`)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    if causal:
+        p = jnp.where(mask[None, None], p, 0.0)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
+    delta = jnp.sum(gf * of, axis=-1)  # [b,h,q]
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_supported(t: int, d: int, block_q: int = 128,
+                              block_k: int = 128) -> bool:
+    """Shape gate: T divisible by both blocks and a lane-friendly head dim.
+
+    Callers (``MultiHeadAttention``) fall back to the XLA blockwise path
+    when this is False — tiny test shapes, ragged sequence lengths.
+    """
+    return t % block_q == 0 and t % block_k == 0 and d % 64 == 0
+
+
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None):
+    """Flash attention over ``[B, T, H, D]`` (the stack's layout).
+
+    ``interpret=None`` auto-selects: compiled on TPU, interpreter elsewhere
+    (so the same code path is unit-testable on the CPU mesh).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not flash_attention_supported(q.shape[1], q.shape[3], block_q, block_k):
+        raise ValueError(
+            f"flash_attention: unsupported shape T={q.shape[1]} D={q.shape[3]}"
+            f" for blocks ({block_q},{block_k}); gate with"
+            " flash_attention_supported()"
+        )
+    # [B,T,H,D] -> [B,H,T,D] for head-major tiling
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out = _flash(qt, kt, vt, causal, block_q, block_k, interpret)
+    return out.transpose(0, 2, 1, 3)
