@@ -18,7 +18,7 @@ Measurement protocol (matters on TPU, doubly so through a remote tunnel):
 - **Best of N trials.**  The tunneled chip is shared: identical runs vary
   >10x wall-clock.  Each trial pipelines ``BENCH_STEPS`` steps; the best
   trial is the capability number (min-time, the standard protocol for noisy
-  shared machines).  Trial spread is reported as ``trial_imgs_per_sec``.
+  shared machines).  Trial spread is reported as ``trial_throughput``.
 - **Feed modes.**  ``BENCH_FEED=placed`` (default): a rotation of batches is
   pre-placed on device outside the timed region — measures the training step
   itself.  ``BENCH_FEED=prefetch``: host uint8 batches stream through the
@@ -47,6 +47,9 @@ NOMINAL = {
     ("wide_resnet", "cpu"): 40.0,
     ("resnet50", "tpu"): 800.0,
     ("resnet50", "cpu"): 4.0,
+    # transformer rows are tokens/sec (unit switches with the model)
+    ("transformer", "tpu"): 100_000.0,
+    ("transformer", "cpu"): 1_000.0,
 }
 
 #: bf16 peak FLOP/s per chip by device-kind substring (override:
@@ -59,7 +62,8 @@ PEAK_TFLOPS = (
     ("v4", 275.0),
 )
 
-#: analytic fwd+bwd FLOPs per image (fallback when cost analysis is absent)
+#: analytic fwd+bwd FLOPs per sample (fallback when cost analysis is absent;
+#: the transformer fallback is computed from param count — see main)
 ANALYTIC_FLOPS = {"resnet50": 3 * 4.1e9, "wide_resnet": 3 * 0.1e9}
 
 
@@ -86,6 +90,18 @@ def build_trainer(model_name: str, platform: str):
         bs = int(bs_env) if bs_env else (256 if platform == "tpu" else 16)
         cfg = {"batch_size": bs, "n_train": bs * 4, "n_val": bs,
                "shard_size": bs}
+    elif model_name == "transformer":
+        from theanompi_tpu.models.transformer_lm import TransformerLM as cls
+
+        bs = int(bs_env) if bs_env else (8 if platform == "tpu" else 2)
+        seq = int(os.environ.get("BENCH_SEQ", "2048" if platform == "tpu"
+                                 else "256"))
+        # n_train/n_val count sequences for the PTB synthetic fallback;
+        # vocab bounded by the [B, T, V] logits (fp32 in the loss): 8k keeps
+        # them ~0.5 GB at the default shape
+        cfg = {"batch_size": bs, "seq_len": seq, "vocab": 8192,
+               "dim": 512, "heads": 8, "n_layers": 8, "dropout": 0.0,
+               "n_train": bs * 8, "n_val": bs * 2}
     else:
         from theanompi_tpu.models.wide_resnet import WideResNet as cls
 
@@ -139,7 +155,13 @@ def main():
 
     flops = step_flops(trainer, host_batches[0])
     if flops is None:
-        flops = ANALYTIC_FLOPS.get(model_name, 0.0) * bs
+        if model_name == "transformer":
+            # the standard 6·N·D training estimate (D = tokens per step)
+            from theanompi_tpu.utils.helper_funcs import tree_count
+
+            flops = 6.0 * tree_count(trainer.params) * bs * model.config["seq_len"]
+        else:
+            flops = ANALYTIC_FLOPS.get(model_name, 0.0) * bs
     peak = chip_peak_flops()
 
     if feed_mode == "placed":
@@ -154,21 +176,27 @@ def main():
     (dt, n, wait_s), results = best_trial(
         trainer, batches, steps, trials, feed_mode=feed_mode
     )
-    per_trial = [tn * bs / tdt for tdt, tn, _ in results]
-
-    images_per_sec = n * bs / dt
+    # transformer throughput is tokens/s (samples/s x seq_len); conv nets
+    # report images/s — the reference's headline unit (BASELINE.md)
+    if model_name == "transformer":
+        per_sample = model.config["seq_len"]
+        unit, noun = "tokens/sec", "tokens"
+    else:
+        per_sample, unit, noun = 1, "images/sec", "images"
+    per_trial = [tn * bs * per_sample / tdt for tdt, tn, _ in results]
+    images_per_sec = n * bs * per_sample / dt
     base = NOMINAL.get((model_name, platform), images_per_sec)
     out = {
-        "metric": f"{model_name}_train_images_per_sec_per_chip_{platform}",
+        "metric": f"{model_name}_train_{noun}_per_sec_per_chip_{platform}",
         "value": round(images_per_sec, 2),
-        "unit": "images/sec",
+        "unit": unit,
         "vs_baseline": round(images_per_sec / base, 3),
         "batch_size": bs,
         "steps": n,
         "feed": feed_mode,
         "step_ms": round(dt / n * 1e3, 2),
         "input_wait_s": round(wait_s, 3),
-        "trial_imgs_per_sec": [round(v, 1) for v in per_trial],
+        "trial_throughput": [round(v, 1) for v in per_trial],
     }
     if flops:
         out["gflops_per_step"] = round(flops / 1e9, 1)
