@@ -96,10 +96,12 @@ def build_trainer(model_name: str, platform: str):
         bs = int(bs_env) if bs_env else (8 if platform == "tpu" else 2)
         seq = int(os.environ.get("BENCH_SEQ", "2048" if platform == "tpu"
                                  else "256"))
-        # n_train/n_val count sequences for the PTB synthetic fallback;
-        # vocab bounded by the [B, T, V] logits (fp32 in the loss): 8k keeps
-        # them ~0.5 GB at the default shape
-        cfg = {"batch_size": bs, "seq_len": seq, "vocab": 8192,
+        # n_train/n_val count sequences for the PTB synthetic fallback.
+        # vocab serves both the model's logits ([B, T, V] fp32 in the loss)
+        # AND the synthetic generator's bigram table (vocab^2 float64 on
+        # host): 2048 keeps the untimed host-side setup to ~32 MB where 8k+
+        # would burn ~0.5 GB and tens of seconds before the timed region
+        cfg = {"batch_size": bs, "seq_len": seq, "vocab": 2048,
                "dim": 512, "heads": 8, "n_layers": 8, "dropout": 0.0,
                "n_train": bs * 8, "n_val": bs * 2}
     else:

@@ -56,6 +56,7 @@ def test_two_process_distributed_bsp(tmp_path):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
         assert f"MULTIHOST_OK pid={pid}" in out, out[-3000:]
+        assert f"MULTIHOST_RULES_OK pid={pid}" in out, out[-3000:]
     # proc 1 never wrote a checkpoint; proc 0 did
     assert any(f.startswith("ckpt_e") for f in os.listdir(dir0))
     assert not os.path.exists(os.path.join(dir1, "latest.json"))
