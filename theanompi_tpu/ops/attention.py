@@ -41,11 +41,12 @@ class MultiHeadAttention(L.Layer):
     dim: int
     heads: int
     causal: bool = True
-    #: "auto" = pallas flash kernel for *inference on TPU* when shapes allow
-    #: (measured ~8% faster fwd); training stays on the XLA blockwise path,
-    #: whose scan-derived backward beats the pallas path's analytic
-    #: backward.  "pallas"/"blockwise" force one when the seq axis is NOT
-    #: sharded; ring attention always wins under sequence parallelism.
+    #: "auto" = pallas flash kernels on TPU when shapes allow — for both
+    #: training and inference (measured: train step 2.8x over the XLA
+    #: blockwise path at T=2048, 3.8x at T=8192, and T=16384 trains where
+    #: XLA out-of-memories).  "pallas"/"blockwise" force one when the seq
+    #: axis is NOT sharded; ring attention always wins under sequence
+    #: parallelism.
     impl: str = "auto"
 
     def __post_init__(self):
@@ -102,7 +103,6 @@ class MultiHeadAttention(L.Layer):
 
             use_pallas = self.impl == "pallas" or (
                 self.impl == "auto"
-                and not train  # auto: fwd-only wins; bwd doesn't (yet)
                 and jax.default_backend() == "tpu"  # win measured on TPU;
                 # elsewhere interpret mode would be pure slowdown
                 and flash_attention_supported(t, head_dim)
