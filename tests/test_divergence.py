@@ -1,0 +1,94 @@
+"""Cross-replica divergence checker (SURVEY.md §5 race-detection row)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from theanompi_tpu.utils.divergence import (
+    assert_replicas_in_sync,
+    replica_divergence,
+)
+
+
+def _replicated(mesh, value):
+    return jax.device_put(value, NamedSharding(mesh, P()))
+
+
+def test_in_sync_replicated_is_zero(mesh8):
+    x = _replicated(mesh8, np.arange(32, dtype=np.float32).reshape(4, 8))
+    assert replica_divergence({"w": x}) == 0.0
+    assert assert_replicas_in_sync({"w": x}) == 0.0
+
+
+def test_sharded_leaves_are_ignored(mesh8):
+    # fully sharded: every shard covers a different index -> no comparison
+    x = jax.device_put(np.arange(8, dtype=np.float32),
+                       NamedSharding(mesh8, P("data")))
+    assert replica_divergence([x]) == 0.0
+
+
+def test_diverged_copy_is_detected(mesh8):
+    # hand-build a "replicated" array whose device copies disagree
+    base = np.ones((8, 8), np.float32)
+    bufs = []
+    for i, d in enumerate(mesh8.devices.flat):
+        v = base.copy()
+        if i == 3:
+            v[0, 0] += 0.5  # one device drifts
+        bufs.append(jax.device_put(v, d))
+    x = jax.make_array_from_single_device_arrays(
+        (8, 8), NamedSharding(mesh8, P()), bufs
+    )
+    assert replica_divergence({"w": x}) == pytest.approx(0.5)
+    with pytest.raises(AssertionError, match="replica divergence"):
+        assert_replicas_in_sync({"w": x})
+    # tolerance lets small drift pass
+    assert assert_replicas_in_sync({"w": x}, atol=1.0) == pytest.approx(0.5)
+
+
+def test_nan_on_one_copy_is_divergence(mesh8):
+    """A NaN on one replica but not others must be flagged, not dropped."""
+    base = np.ones((8, 8), np.float32)
+    bufs = []
+    for i, d in enumerate(mesh8.devices.flat):
+        v = base.copy()
+        if i == 5:
+            v[0, 0] = np.nan
+        bufs.append(jax.device_put(v, d))
+    x = jax.make_array_from_single_device_arrays(
+        (8, 8), NamedSharding(mesh8, P()), bufs
+    )
+    assert replica_divergence({"w": x}) == float("inf")
+    with pytest.raises(AssertionError, match="replica divergence"):
+        assert_replicas_in_sync({"w": x}, atol=1e9)  # no atol excuses NaN
+
+
+def test_matching_nans_are_in_sync(mesh8):
+    """Identical NaN patterns on every copy are consistent, not divergent."""
+    base = np.ones((8, 8), np.float32)
+    base[1, 1] = np.nan
+    x = _replicated(mesh8, base)
+    assert replica_divergence({"w": x}) == 0.0
+
+
+def test_bsp_trainer_stays_in_sync(mesh8):
+    """End-to-end: after BSP steps the trainer's replicated params must be
+    bit-identical on all 8 devices (the invariant the checker exists for)."""
+    from theanompi_tpu.models.wide_resnet import WideResNet
+    from theanompi_tpu.parallel.bsp import BSPTrainer
+
+    model = WideResNet({"depth": 10, "widen": 1, "batch_size": 2,
+                        "image_size": 8, "n_train": 64, "n_val": 16,
+                        "n_epochs": 1, "precision": "fp32",
+                        "bn_axis": "data", "verbose": False})
+    t = BSPTrainer(model, mesh=mesh8)
+    t.compile_iter_fns()
+    t.init_state()
+    for i, batch in enumerate(model.data.train_batches(t.global_batch, 0, seed=0)):
+        t.train_iter(batch, lr=0.05)
+        if i >= 1:
+            break
+    assert t.check_divergence() == 0.0

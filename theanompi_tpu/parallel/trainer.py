@@ -225,6 +225,19 @@ class BaseTrainer:
             verbose=self.recorder.verbose,
         )
 
+    def check_divergence(self, atol: float = 0.0) -> float:
+        """Assert replicated param/state copies are in sync across devices.
+
+        Debug hook (SURVEY.md §5 race-detection row): call at epoch
+        boundaries when chasing non-determinism or exchange bugs; costs a
+        device→host pull of the trees.
+        """
+        from theanompi_tpu.utils.divergence import assert_replicas_in_sync
+
+        d1 = assert_replicas_in_sync(self.params, atol=atol, what="params")
+        d2 = assert_replicas_in_sync(self.state, atol=atol, what="state")
+        return max(d1, d2)
+
     def checkpoint_trees(self) -> dict:
         """Named pytrees a checkpoint must capture (rules add extras)."""
         return {

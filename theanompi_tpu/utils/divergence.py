@@ -1,0 +1,69 @@
+"""Cross-replica divergence check (SURVEY.md §5 race-detection row).
+
+The reference had no race detection; under SPMD the one real invariant is
+that *replicated* values stay bit-identical across their device copies —
+BSP params after the fused all-reduce, EASGD's center, batch-norm state
+under sync-BN.  A divergence means a non-deterministic op, a wrong
+``grad_reduce_axes``, or an exchange bug (exactly the class the round-1
+Megatron-gradient bug belonged to), and shard_map's ``check_rep=False``
+hides it silently.
+
+The check is host-side and collective-free: every device copy of a
+replicated leaf is an addressable shard covering the same index, so the
+copies can be fetched and compared directly.  Cost is a device→host pull
+of the tree — a debug tool, not a per-step assertion; wire it at epoch
+boundaries via ``BaseTrainer.check_divergence()``.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def replica_divergence(tree) -> float:
+    """Max |difference| between same-index device copies across the tree.
+
+    Leaves without multiple same-index addressable shards (fully sharded
+    arrays, scalars on one device) contribute nothing.  0.0 means every
+    replicated copy is bit-identical.
+    """
+    worst = 0.0
+    for leaf in jax.tree.leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None or len(shards) < 2:
+            continue
+        by_index: dict = {}
+        for s in shards:
+            key = tuple(
+                (sl.start, sl.stop, sl.step) for sl in s.index
+            ) if s.index else ()
+            by_index.setdefault(key, []).append(s)
+        for copies in by_index.values():
+            if len(copies) < 2:
+                continue
+            ref = np.asarray(copies[0].data).astype(np.float64)
+            ref_nan = np.isnan(ref)
+            for s in copies[1:]:
+                cur = np.asarray(s.data).astype(np.float64)
+                cur_nan = np.isnan(cur)
+                if (cur_nan != ref_nan).any():
+                    # a NaN on one copy but not another IS divergence (the
+                    # prime symptom of the bugs this tool exists to catch);
+                    # naive max() would silently drop the NaN comparison
+                    return float("inf")
+                diff = np.where(ref_nan, 0.0, np.abs(cur - ref))
+                worst = max(worst, float(np.max(diff)) if diff.size else 0.0)
+    return worst
+
+
+def assert_replicas_in_sync(tree, atol: float = 0.0, what: str = "tree") -> float:
+    """Raise if replicated copies diverge beyond ``atol``; -> measured max."""
+    d = replica_divergence(tree)
+    if d > atol:
+        raise AssertionError(
+            f"replica divergence in {what}: max |delta| = {d} > {atol} — "
+            "a replicated value differs between device copies (wrong "
+            "reduce axes, non-determinism, or an exchange bug)"
+        )
+    return d
