@@ -66,6 +66,44 @@ def test_nan_on_one_copy_is_divergence(mesh8):
         assert_replicas_in_sync({"w": x}, atol=1e9)  # no atol excuses NaN
 
 
+def test_pairwise_spread_not_just_vs_first(mesh8):
+    """Copies 0.6 / 1.0 / 1.4 diverge by 0.8 pairwise even though each is
+    only 0.4 from copy 0."""
+    vals = [0.6, 1.0, 1.4, 1.0, 1.0, 1.0, 1.0, 1.0]
+    bufs = [jax.device_put(np.full((8, 8), vals[i], np.float32), d)
+            for i, d in enumerate(mesh8.devices.flat)]
+    x = jax.make_array_from_single_device_arrays(
+        (8, 8), NamedSharding(mesh8, P()), bufs)
+    assert replica_divergence({"w": x}) == pytest.approx(0.8, abs=1e-6)
+
+
+def test_matching_infs_in_sync_but_real_divergence_still_seen(mesh8):
+    """inf on every copy at one index must not mask a finite divergence at
+    another (inf - inf = NaN would poison a naive max)."""
+    bufs = []
+    for i, d in enumerate(mesh8.devices.flat):
+        v = np.ones((8, 8), np.float32)
+        v[0, 0] = np.inf  # blow-up on EVERY copy: consistent
+        if i == 2:
+            v[1, 1] = 5.0  # the real divergence
+        bufs.append(jax.device_put(v, d))
+    x = jax.make_array_from_single_device_arrays(
+        (8, 8), NamedSharding(mesh8, P()), bufs)
+    assert replica_divergence({"w": x}) == pytest.approx(4.0)
+
+
+def test_inf_on_one_copy_is_divergence(mesh8):
+    bufs = []
+    for i, d in enumerate(mesh8.devices.flat):
+        v = np.ones((8, 8), np.float32)
+        if i == 4:
+            v[0, 0] = np.inf
+        bufs.append(jax.device_put(v, d))
+    x = jax.make_array_from_single_device_arrays(
+        (8, 8), NamedSharding(mesh8, P()), bufs)
+    assert replica_divergence({"w": x}) == float("inf")
+
+
 def test_matching_nans_are_in_sync(mesh8):
     """Identical NaN patterns on every copy are consistent, not divergent."""
     base = np.ones((8, 8), np.float32)

@@ -98,6 +98,55 @@ def test_ring_attention_matches_full(causal):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_grads_match_full(causal):
+    """The ring's custom second-pass VJP (circulating (k,v,dk,dv) bundle)
+    must match autodiff of full attention — without it, autodiff would save
+    every hop's probability block (O(T^2/n) per device)."""
+    n = 8
+    mesh = make_mesh(n_data=1, n_seq=n)
+    r = np.random.RandomState(7)
+    b, t, h, d = 2, 64, 2, 8
+    q, k, v = (r.randn(b, t, h, d).astype(np.float32) for _ in range(3))
+
+    f = jax.jit(
+        shard_map(
+            lambda q, k, v: ring_attention(q, k, v, causal=causal),
+            mesh,
+            in_specs=(P(None, SEQ_AXIS), P(None, SEQ_AXIS), P(None, SEQ_AXIS)),
+            out_specs=P(None, SEQ_AXIS),
+        )
+    )
+    sh = NamedSharding(mesh, P(None, SEQ_AXIS))
+    qd, kd, vd = (jax.device_put(x, sh) for x in (q, k, v))
+
+    def loss_ring(q, k, v):
+        return jnp.sum(jnp.sin(f(q, k, v)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(jnp.asarray(
+            _reference_attention_jnp(q, k, v, causal))))
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(qd, kd, vd)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for gr, gf, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gr), np.asarray(gf), rtol=5e-4, atol=5e-5,
+            err_msg=f"ring d{name} mismatch",
+        )
+
+
+def _reference_attention_jnp(q, k, v, causal):
+    b, t, h, d = q.shape
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
 def test_specs_from_rules_paths():
     params = {
         "net": {

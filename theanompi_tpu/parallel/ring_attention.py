@@ -21,6 +21,8 @@ ppermute hop with the current block's compute where dependencies allow.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -86,16 +88,17 @@ def blockwise_attention(q, k, v, causal: bool = False, block_size: int | None = 
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, causal: bool = False, axis_name: str = SEQ_AXIS):
-    """Sequence-parallel attention inside ``shard_map`` over ``axis_name``.
+def _ring_mask(me, src, t):
+    """Causal mask tile for local q rows vs the KV block that originated at
+    ``src`` (global block offsets): [1, 1, t, t]."""
+    q_pos = me * t + jnp.arange(t)
+    k_pos = src * t + jnp.arange(t)
+    return (q_pos[:, None] >= k_pos[None, :])[None, None]
 
-    q/k/v: the LOCAL sequence shard, [B, T_local, H, D].  Equivalent to full
-    attention over the gathered sequence (see tests), with KV circulating the
-    ring instead of being gathered.
-    """
+
+def _ring_forward(q, k, v, causal, axis_name):
+    """The KV-circulating forward; -> (out [B,T,H,D], lse [B,H,T])."""
     n = lax.axis_size(axis_name)
-    if n == 1:
-        return blockwise_attention(q, k, v, causal=causal)
     me = lax.axis_index(axis_name)
     b, t, h, d = q.shape
 
@@ -104,7 +107,6 @@ def ring_attention(q, k, v, causal: bool = False, axis_name: str = SEQ_AXIS):
     l = jnp.zeros((b, h, t), jnp.float32)
     acc = jnp.zeros((b, t, h, d), jnp.float32)
     ring = [(i, (i + 1) % n) for i in range(n)]
-    q_pos = me * t + jnp.arange(t)
 
     kv = (k, v)
     for hop in range(n):
@@ -112,10 +114,7 @@ def ring_attention(q, k, v, causal: bool = False, axis_name: str = SEQ_AXIS):
         # originated at (me - hop) mod n
         src = (me - hop) % n
         kb, vb = kv
-        mask = None
-        if causal:
-            k_pos = src * t + jnp.arange(t)
-            mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+        mask = _ring_mask(me, src, t) if causal else None
         m, l, acc = _block_attend(
             qf, kb.astype(jnp.float32), vb, m, l, acc, mask
         )
@@ -126,4 +125,80 @@ def ring_attention(q, k, v, causal: bool = False, axis_name: str = SEQ_AXIS):
     # diagonal is always visible, but guard the division anyway)
     l_safe = jnp.maximum(l, 1e-30)
     out = acc / l_safe.transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    return out.astype(q.dtype), m + jnp.log(l_safe)
+
+
+def ring_attention(q, k, v, causal: bool = False, axis_name: str = SEQ_AXIS):
+    """Sequence-parallel attention inside ``shard_map`` over ``axis_name``.
+
+    q/k/v: the LOCAL sequence shard, [B, T_local, H, D].  Equivalent to full
+    attention over the gathered sequence (see tests), with KV circulating the
+    ring instead of being gathered.
+
+    The backward is a custom second ring pass (Liu et al. 2023 §3): plain
+    autodiff of the forward would save every hop's [T_local, T_local]
+    probability block — O(T²/n) per device, the exact thing ring attention
+    exists to avoid.  Instead the VJP recomputes probabilities per hop from
+    the saved (q, k, v, out, lse) and circulates a (k, v, dk, dv) bundle a
+    full lap, so each shard's dk/dv accumulate contributions from every
+    query shard and arrive back home; residual memory stays O(T·d/n).
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return blockwise_attention(q, k, v, causal=causal)
+    return _ring_flash(q, k, v, causal, axis_name)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_flash(q, k, v, causal, axis_name):
+    out, _ = _ring_forward(q, k, v, causal, axis_name)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, causal, axis_name):
+    out, lse = _ring_forward(q, k, v, causal, axis_name)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(causal, axis_name, res, g):
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    scale = d ** -0.5
+    ring = [(i, (i + 1) % n) for i in range(n)]
+
+    qf = q.astype(jnp.float32)
+    do = g.astype(jnp.float32)
+    # delta_i = sum_d dO_i * O_i : [B, H, T] (lse's layout)
+    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1).transpose(0, 2, 1)
+
+    dq = jnp.zeros((b, t, h, d), jnp.float32)
+    bundle = (k, v,
+              jnp.zeros((b, t, h, d), jnp.float32),
+              jnp.zeros((b, t, h, d), jnp.float32))
+    for hop in range(n):
+        src = (me - hop) % n
+        kb, vb, dkb, dvb = bundle
+        kbf, vbf = kb.astype(jnp.float32), vb.astype(jnp.float32)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kbf,
+                       preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse[..., None])
+        if causal:
+            p = jnp.where(_ring_mask(me, src, t), p, 0.0)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", do, vbf,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, kbf)
+        dkb = dkb + jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+        dvb = dvb + jnp.einsum("bhqk,bqhd->bkhd", p, do)
+        # permute after EVERY hop (n total): each KV block visits all query
+        # shards and its accumulated dk/dv land back on its home shard
+        bundle = jax.tree.map(
+            lambda x: lax.ppermute(x, axis_name, ring), (kb, vb, dkb, dvb)
+        )
+    _, _, dk, dv = bundle
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)

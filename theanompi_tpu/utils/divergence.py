@@ -42,18 +42,25 @@ def replica_divergence(tree) -> float:
         for copies in by_index.values():
             if len(copies) < 2:
                 continue
-            ref = np.asarray(copies[0].data).astype(np.float64)
-            ref_nan = np.isnan(ref)
-            for s in copies[1:]:
-                cur = np.asarray(s.data).astype(np.float64)
-                cur_nan = np.isnan(cur)
-                if (cur_nan != ref_nan).any():
+            arrs = [np.asarray(s.data).astype(np.float64) for s in copies]
+            ref_nan = np.isnan(arrs[0])
+            for a in arrs[1:]:
+                if (np.isnan(a) != ref_nan).any():
                     # a NaN on one copy but not another IS divergence (the
                     # prime symptom of the bugs this tool exists to catch);
                     # naive max() would silently drop the NaN comparison
                     return float("inf")
-                diff = np.where(ref_nan, 0.0, np.abs(cur - ref))
-                worst = max(worst, float(np.max(diff)) if diff.size else 0.0)
+            # max PAIRWISE spread via elementwise min/max over all copies
+            # (comparing only against copies[0] under-reports by up to 2x);
+            # matching NaN/inf positions are equal, mixed inf-vs-finite
+            # yields inf spread
+            stack = np.where(np.isnan(arrs), 0.0, np.stack(arrs))
+            hi, lo = stack.max(axis=0), stack.min(axis=0)
+            # subtract only where copies differ: matching infs would warn
+            # (inf - inf) even though the result is masked
+            spread = np.zeros_like(hi)
+            np.subtract(hi, lo, out=spread, where=hi != lo)
+            worst = max(worst, float(spread.max()) if spread.size else 0.0)
     return worst
 
 
