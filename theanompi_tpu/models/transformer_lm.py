@@ -36,12 +36,23 @@ from jax.sharding import PartitionSpec as P
 
 @dataclasses.dataclass(frozen=True)
 class _Block(L.Layer):
-    """Pre-norm transformer block: LN→MHA→res, LN→MLP(4x, gelu)→res."""
+    """Pre-norm transformer block: LN→MHA→res, LN→FFN→res.
+
+    The FFN half is a hook (``_ffn_subs``/``_apply_ffn``) so variants
+    (:class:`_MoEBlock`) swap only that segment instead of copying the
+    residual/LN/dropout wiring."""
 
     dim: int
     heads: int
     dropout: float = 0.0
     attn_impl: str = "auto"
+
+    def _ffn_subs(self):
+        w02 = init_lib.normal(0.02)
+        return (
+            ("up", ColumnParallelDense(4 * self.dim, w_init=w02)),
+            ("down", RowParallelDense(self.dim, w_init=w02)),
+        )
 
     def _subs(self):
         return (
@@ -49,26 +60,31 @@ class _Block(L.Layer):
             ("attn", MultiHeadAttention(self.dim, self.heads, causal=True,
                                         impl=self.attn_impl)),
             ("ln2", L.LayerNorm()),
-            ("up", ColumnParallelDense(4 * self.dim, w_init=init_lib.normal(0.02))),
-            ("down", RowParallelDense(self.dim, w_init=init_lib.normal(0.02))),
+            *self._ffn_subs(),
         )
+
+    def _apply_ffn(self, subs, params, state, h, train):
+        """-> (h, ffn_state); the MLP default carries no state."""
+        h, _ = subs["up"].apply(params["up"], {}, h)
+        h = jax.nn.gelu(h)
+        h, _ = subs["down"].apply(params["down"], {}, h)
+        return h, {}
 
     def init(self, key, in_shape):
         params, state = {}, {}
-        keys = jax.random.split(key, 5)
-        shape = in_shape
-        for (name, layer), k in zip(self._subs(), keys):
-            if name in ("ln1", "ln2", "attn"):
-                p, s, _ = layer.init(k, in_shape)
-            elif name == "up":
-                p, s, up_shape = layer.init(k, in_shape)
-            else:
-                p, s, _ = layer.init(k, up_shape)
+        subs = self._subs()
+        ffn_names = {n for n, _ in self._ffn_subs()}
+        keys = jax.random.split(key, len(subs))
+        shape = tuple(in_shape)  # chained through the FFN segment only
+        for (name, layer), k in zip(subs, keys):
+            p, s, out = layer.init(k, shape if name in ffn_names else in_shape)
+            if name in ffn_names:
+                shape = out
             if p:
                 params[name] = p
             if s:
                 state[name] = s
-        return params, state, tuple(shape)
+        return params, state, tuple(in_shape)
 
     def apply(self, params, state, x, *, train=False, rng=None):
         subs = dict(self._subs())
@@ -82,11 +98,30 @@ class _Block(L.Layer):
         h, _ = drop.apply({}, {}, h, train=train, rng=rngs[0])
         x = x + h
         h, _ = subs["ln2"].apply(params["ln2"], {}, x)
-        h, _ = subs["up"].apply(params["up"], {}, h)
-        h = jax.nn.gelu(h)
-        h, _ = subs["down"].apply(params["down"], {}, h)
+        h, ffn_state = self._apply_ffn(subs, params, state, h, train)
         h, _ = drop.apply({}, {}, h, train=train, rng=rngs[1])
-        return x + h, state
+        return x + h, ffn_state
+
+
+@dataclasses.dataclass(frozen=True)
+class _MoEBlock(_Block):
+    """:class:`_Block` with a switch-routed MoE FFN; the MoE's load-balance
+    aux loss rides in state under ``moe.aux``."""
+
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+
+    def _ffn_subs(self):
+        from theanompi_tpu.ops.moe import MoEFFN
+
+        return (("moe", MoEFFN(self.dim, self.n_experts,
+                               capacity_factor=self.capacity_factor)),)
+
+    def _apply_ffn(self, subs, params, state, h, train):
+        h, moe_state = subs["moe"].apply(
+            params["moe"], state.get("moe", {}), h, train=train
+        )
+        return h, {"moe": moe_state}
 
 
 class TransformerLM(SupervisedModel):
@@ -110,6 +145,12 @@ class TransformerLM(SupervisedModel):
     def build_data(self):
         return PTBData(self.config)
 
+    def _make_block(self) -> L.Layer:
+        """Block factory hook — MoE variant overrides with :class:`_MoEBlock`."""
+        cfg = self.config
+        return _Block(cfg["dim"], cfg["heads"], dropout=cfg["dropout"],
+                      attn_impl=cfg["attn_impl"])
+
     def build_net(self):
         cfg = self.config
         layers: list[L.Layer] = [
@@ -118,8 +159,7 @@ class TransformerLM(SupervisedModel):
             PositionEmbedding(cfg["seq_len"], cfg["dim"]),
         ]
         for _ in range(cfg["n_layers"]):
-            layers.append(_Block(cfg["dim"], cfg["heads"], cfg["dropout"],
-                                 attn_impl=cfg["attn_impl"]))
+            layers.append(self._make_block())
         layers += [
             L.LayerNorm(),
             L.Dense(self.data.vocab, w_init=init_lib.glorot_normal),
@@ -147,3 +187,196 @@ class TransformerLM(SupervisedModel):
         metrics = dict(metrics)
         metrics["perplexity"] = jnp.exp(metrics["cost"])
         return loss, (new_state, metrics)
+
+
+class MoETransformerLM(TransformerLM):
+    """Mixture-of-experts LM: dp × tp × **ep** (SURVEY.md-beyond).
+
+    Every block's FFN is a switch-routed :class:`~theanompi_tpu.ops.moe
+    .MoEFFN` with ``n_experts`` global experts sharded over the ``model``
+    mesh axis (expert parallelism shares the axis with the attention's
+    tensor parallelism — the standard pairing).  The Switch load-balance
+    auxiliary loss joins the training objective at ``moe_aux_weight``.
+    """
+
+    default_config = {
+        **TransformerLM.default_config,
+        "n_experts": 8,
+        "capacity_factor": 1.25,
+        "moe_aux_weight": 0.01,
+    }
+
+    def _make_block(self) -> L.Layer:
+        cfg = self.config
+        return _MoEBlock(
+            cfg["dim"], cfg["heads"], dropout=cfg["dropout"],
+            attn_impl=cfg["attn_impl"], n_experts=cfg["n_experts"],
+            capacity_factor=cfg["capacity_factor"],
+        )
+
+    def param_specs(self, params):
+        from theanompi_tpu.parallel.mesh import MODEL_AXIS
+
+        base = specs_from_rules(params, TP_RULES)
+        expert_keys = ("up_w", "up_b", "down_w", "down_b")
+
+        def walk(p_sub, b_sub, in_moe, key):
+            if isinstance(p_sub, dict):
+                return {k: walk(p_sub[k], b_sub[k], in_moe or k == "moe", k)
+                        for k in p_sub}
+            if in_moe and key in expert_keys:
+                return P(MODEL_AXIS)  # stacked experts shard dim 0
+            return b_sub
+
+        return walk(params, base, False, "")
+
+    def loss_fn(self, params, state, batch, rng, train: bool):
+        import jax.tree_util as jtu
+
+        loss, (new_state, metrics) = super().loss_fn(
+            params, state, batch, rng, train
+        )
+        auxes = [
+            leaf for path, leaf in jtu.tree_flatten_with_path(new_state)[0]
+            if getattr(path[-1], "key", None) == "aux"
+        ]
+        if auxes:
+            a = sum(auxes) / len(auxes)
+            metrics = {**metrics, "moe_aux": a}
+            if train:
+                loss = loss + self.config["moe_aux_weight"] * a
+        return loss, (new_state, metrics)
+
+
+class PipelineTransformerLM(TransformerLM):
+    """Pipeline-parallel variant: dp × pp (SURVEY.md-beyond, scale contract).
+
+    The ``n_layers`` blocks are *stacked* — every block-param leaf carries a
+    leading ``[n_layers, ...]`` axis sharded over the ``pipe`` mesh axis —
+    and the forward runs the GPipe collective-permute schedule
+    (:func:`theanompi_tpu.parallel.pipeline.pipeline_apply`) with
+    ``n_micro`` microbatches.  Embedding/positions/final-LN/head are
+    replicated; their cross-pipe gradient correctness comes from the
+    pinned-VJP collectives inside ``pipeline_apply``.  With pipe size 1
+    (or no mesh) this is numerically the plain stacked transformer.
+
+    Not yet composed with tensor/sequence parallelism: ``param_specs``
+    shards block leaves over ``pipe`` only.
+    """
+
+    default_config = {
+        **TransformerLM.default_config,
+        "n_micro": 4,       # microbatches per step (must divide batch_size)
+        "seq_parallel": False,
+    }
+
+    def build_net(self):
+        cfg = self.config
+        t, d = cfg["seq_len"], cfg["dim"]
+        self._block = _Block(cfg["dim"], cfg["heads"], cfg["dropout"],
+                             attn_impl=cfg["attn_impl"])
+        self._embed = L.Embedding(self.data.vocab, d,
+                                  w_init=init_lib.normal(0.02))
+        self._pos = PositionEmbedding(t, d)
+        self._ln_f = L.LayerNorm()
+        self._head = L.Dense(self.data.vocab, w_init=init_lib.glorot_normal)
+        return None, (t,)
+
+    def init_params(self, rng):
+        cfg = self.config
+        t, d = cfg["seq_len"], cfg["dim"]
+        k_embed, k_pos, k_blocks, k_ln, k_head = jax.random.split(rng, 5)
+        pe, _, _ = self._embed.init(k_embed, (t,))
+        pp, _, _ = self._pos.init(k_pos, (t, d))
+        block_keys = jax.random.split(k_blocks, cfg["n_layers"])
+
+        def one(k):
+            p, _, _ = self._block.init(k, (t, d))
+            return p
+
+        stacked = jax.vmap(one)(block_keys)  # leaves [n_layers, ...]
+        pl_, _, _ = self._ln_f.init(k_ln, (t, d))
+        ph, _, _ = self._head.init(k_head, (t, d))
+        return {"embed": pe, "pos": pp, "blocks": stacked,
+                "ln_f": pl_, "head": ph}, {}
+
+    def param_specs(self, params):
+        from theanompi_tpu.parallel.mesh import PIPE_AXIS
+
+        return {
+            "embed": jax.tree.map(lambda _: P(), params["embed"]),
+            "pos": jax.tree.map(lambda _: P(), params["pos"]),
+            # stacked block leaves shard their leading stage axis
+            "blocks": jax.tree.map(lambda _: P(PIPE_AXIS), params["blocks"]),
+            "ln_f": jax.tree.map(lambda _: P(), params["ln_f"]),
+            "head": jax.tree.map(lambda _: P(), params["head"]),
+        }
+
+    def loss_fn(self, params, state, batch, rng, train: bool):
+        from theanompi_tpu.ops import softmax_cross_entropy, top_k_error
+        from theanompi_tpu.parallel.pipeline import pipeline_apply
+        from theanompi_tpu.parallel.tensor import axis_bound
+
+        cfg = self.config
+        # not yet composed with tensor/sequence parallelism: block specs
+        # replicate over `model`, so the blocks' TP collectives would
+        # double-count silently — refuse instead
+        for ax in ("model", "seq"):
+            if axis_bound(ax) and jax.lax.axis_size(ax) > 1:
+                raise ValueError(
+                    f"PipelineTransformerLM does not compose with a sharded"
+                    f" {ax!r} axis yet; use n_model=1, n_seq=1"
+                )
+        cp = self.precision.cast_to_compute(params)
+        emb, _ = self._embed.apply(cp["embed"], {}, batch["x"])
+        emb, _ = self._pos.apply(cp["pos"], {}, emb)
+
+        def stage_fn(chunk, act, t):
+            if rng is None:
+                key0 = None
+            else:
+                key0 = jax.random.fold_in(rng, t)
+                if axis_bound("pipe"):
+                    key0 = jax.random.fold_in(
+                        key0, jax.lax.axis_index("pipe"))
+
+            def one(carry, bp):
+                a, key = carry
+                kb = None
+                if key is not None:
+                    key = jax.random.fold_in(key, 7)
+                    kb = key
+                y, _ = self._block.apply(bp, {}, a, train=train, rng=kb)
+                return (y, key), None
+
+            (act, _), _ = jax.lax.scan(one, (act, key0), chunk)
+            return act
+
+        h = pipeline_apply(stage_fn, cp["blocks"], emb, cfg["n_micro"])
+        h, _ = self._ln_f.apply(cp["ln_f"], {}, h)
+        logits, _ = self._head.apply(cp["head"], {}, h)
+        y = batch["y"]
+        loss = softmax_cross_entropy(logits, y)
+        if cfg.get("l2", 0.0):
+            # block leaves are pipe-sharded: psum their squared norms so the
+            # l2 term (and hence the loss) is replicated across stages
+            blocks_sq = sum(
+                jnp.sum(jnp.square(p.astype(jnp.float32)))
+                for p in jax.tree.leaves(params["blocks"])
+            )
+            if axis_bound("pipe") and jax.lax.axis_size("pipe") > 1:
+                blocks_sq = jax.lax.psum(blocks_sq, "pipe")
+            other_sq = sum(
+                jnp.sum(jnp.square(p.astype(jnp.float32)))
+                for k in ("embed", "pos", "ln_f", "head")
+                for p in jax.tree.leaves(params[k])
+            )
+            loss = loss + cfg["l2"] * (blocks_sq + other_sq)
+        metrics = {
+            "cost": loss,
+            "error": top_k_error(logits, y, k=1),
+            "error_top5": top_k_error(logits, y, k=5)
+            if logits.shape[-1] >= 5 else jnp.zeros((), jnp.float32),
+            "perplexity": jnp.exp(loss),
+        }
+        return loss, (state, metrics)

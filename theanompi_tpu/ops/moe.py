@@ -1,0 +1,187 @@
+"""Mixture-of-experts FFN with expert parallelism over the ``model`` axis.
+
+Beyond the reference's capability set (SURVEY.md §2 — 2016), completing the
+framework's parallelism surface: dp (rules) × tp (tensor.py) × sp (ring
+attention) × pp (pipeline.py) × **ep** (here).  Expert parallelism reuses
+the ``model`` mesh axis — the standard choice: EP and TP occupy the same
+device group, and a layer uses one or the other.
+
+Routing is top-1 switch style (Fedus et al. 2021) in its einsum/one-hot
+form — dense masks, static shapes, no sorting — which is how every
+XLA-friendly MoE is written:
+
+- gate logits → top-1 expert per token, gate prob as the combine weight;
+- per-expert capacity ``C = ceil(tokens/E · capacity_factor)``: position
+  within the expert via a cumsum over the token axis, tokens beyond C are
+  DROPPED (contribute zero; the transformer's residual carries them);
+- dispatch einsum builds ``[E, C, D]``, ``lax.all_to_all`` over the model
+  axis exchanges expert-major slabs so each shard holds its local experts'
+  tokens from every peer, the local experts run as one vmapped MLP, and
+  the inverse all_to_all + combine einsum returns weighted outputs.
+
+With the model axis unbound or size 1 every expert is local and the
+all_to_alls vanish — the same code is the single-device reference the EP
+tests compare against.  The auxiliary load-balancing loss (same paper,
+``aux_loss``) is returned alongside so callers can add it at their chosen
+weight.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from theanompi_tpu.ops import initializers as init_lib
+from theanompi_tpu.ops import layers as L
+from theanompi_tpu.parallel.mesh import MODEL_AXIS
+from theanompi_tpu.parallel.tensor import axis_bound
+
+
+def _ep_size(axis_name):
+    if axis_bound(axis_name) and lax.axis_size(axis_name) > 1:
+        return lax.axis_size(axis_name)
+    return 1
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEFFN(L.Layer):
+    """Switch-routed expert FFN over ``[B, T, D]``.
+
+    ``n_experts`` is GLOBAL; with EP over ``axis_name`` each shard holds
+    ``n_experts / ep`` experts (stacked leading axis on every expert param
+    leaf — shard dim 0 over the axis in ``param_specs``).  The
+    load-balance auxiliary loss rides in the layer's *state* under
+    ``"aux"`` (replicated across ranks); the model adds it to the training
+    loss at its chosen weight.
+    """
+
+    dim: int
+    n_experts: int
+    hidden_mult: int = 4
+    capacity_factor: float = 1.25
+    axis_name: str = MODEL_AXIS
+
+    def init(self, key, in_shape):
+        d = in_shape[-1]
+        if d != self.dim:
+            raise ValueError(f"MoEFFN dim {self.dim} != input {d}")
+        kg, ku, kd = jax.random.split(key, 3)
+        h = self.hidden_mult * d
+        w02 = init_lib.normal(0.02)
+        params = {
+            "gate": {"w": w02(kg, (d, self.n_experts))},
+            # stacked expert weights: [E, d, h] / [E, h] / [E, h, d] / [E, d]
+            "up_w": w02(ku, (self.n_experts, d, h)),
+            "up_b": jnp.zeros((self.n_experts, h), jnp.float32),
+            "down_w": w02(kd, (self.n_experts, h, d)),
+            "down_b": jnp.zeros((self.n_experts, d), jnp.float32),
+        }
+        return params, {"aux": jnp.zeros((), jnp.float32)}, tuple(in_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        from theanompi_tpu.parallel.tensor import (
+            identity_fwd_psum_bwd,
+            psum_fwd_identity_bwd,
+        )
+
+        b, t, d = x.shape
+        n_tok = b * t
+        E = self.n_experts
+        ep = _ep_size(self.axis_name)
+        xt = x.reshape(n_tok, d)
+
+        # token slicing: activations are replicated across the EP axis (TP
+        # semantics), so each rank routes only its 1/ep slice of the tokens
+        # — that is what makes the expert compute actually parallel.  The
+        # Megatron-f wrap repairs the sliced cotangent (each rank's is the
+        # partial for its chunk); the final g-op psum rebuilds the full
+        # token output from the per-rank padded slices.
+        gate_w = params["gate"]["w"]
+        if ep > 1:
+            if n_tok % ep:
+                raise ValueError(f"tokens {n_tok} not divisible by ep={ep}")
+            if E % ep:
+                raise ValueError(f"{E} experts not divisible by ep={ep}")
+            chunk = n_tok // ep
+            me = lax.axis_index(self.axis_name)
+            xt_full = identity_fwd_psum_bwd(xt, self.axis_name)
+            xt_loc = lax.dynamic_slice_in_dim(xt_full, me * chunk, chunk, 0)
+            # the gate weight is replicated but each rank's cotangent for it
+            # covers only its token chunk: pin the param with Megatron-f so
+            # the partials sum to the true (replicated) gradient
+            gate_w = identity_fwd_psum_bwd(gate_w, self.axis_name)
+        else:
+            chunk = n_tok
+            xt_loc = xt
+
+        # -- route: top-1 expert + prob weight --------------------------------
+        logits = xt_loc.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)            # [N, E]
+        expert = jnp.argmax(probs, axis=-1)                # [N]
+        onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)
+        gate = jnp.sum(probs * onehot, axis=-1)            # [N]
+
+        # load-balance aux loss (Switch eq. 4): E * sum_e f_e * P_e, meaned
+        # over the EP ranks so the stashed value is replicated
+        f = jnp.mean(onehot, axis=0)
+        p_mean = jnp.mean(probs, axis=0)
+        aux = E * jnp.sum(f * p_mean)
+        if ep > 1:
+            aux = lax.pmean(aux, self.axis_name)
+
+        # -- capacity + position ----------------------------------------------
+        cap = int(max(1, -(-chunk * self.capacity_factor // E)))
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0    # [N, E]; -1 = not routed
+        keep = (pos >= 0) & (pos < cap)
+        pos_oh = jax.nn.one_hot(pos.max(axis=-1), cap, dtype=jnp.float32)
+        sel = (keep.sum(axis=-1) > 0).astype(jnp.float32)  # token survived
+
+        # dispatch [N, E, C]: token n -> (its expert, its slot), if kept
+        dispatch = onehot[:, :, None] * pos_oh[:, None, :] * sel[:, None, None]
+        slabs = jnp.einsum("nec,nd->ecd", dispatch,
+                           xt_loc.astype(jnp.float32))     # [E, C, D]
+
+        if ep > 1:
+            e_local = E // ep
+            # expert-major slabs: peer p gets my tokens for ITS experts
+            slabs = slabs.reshape(ep, e_local, cap, d)
+            slabs = lax.all_to_all(
+                slabs, self.axis_name, split_axis=0, concat_axis=0,
+                tiled=False,
+            )  # [ep, e_local, C, D]: dim 0 now indexes source rank
+            slabs = slabs.transpose(1, 0, 2, 3).reshape(e_local, ep * cap, d)
+        else:
+            e_local = E
+
+        # -- local experts: one vmapped MLP over the stacked weights ----------
+        def expert_mlp(up_w, up_b, down_w, down_b, h_in):
+            y = jnp.einsum("cd,dh->ch", h_in, up_w.astype(jnp.float32))
+            y = jax.nn.gelu(y + up_b[None, :])
+            y = jnp.einsum("ch,hd->cd", y, down_w.astype(jnp.float32))
+            return y + down_b[None, :]
+
+        out_slabs = jax.vmap(expert_mlp)(
+            params["up_w"].astype(jnp.float32), params["up_b"],
+            params["down_w"].astype(jnp.float32), params["down_b"], slabs,
+        )  # [e_local, *, D]
+
+        if ep > 1:
+            out_slabs = out_slabs.reshape(e_local, ep, cap, d)
+            out_slabs = out_slabs.transpose(1, 0, 2, 3)    # [ep, e_local, C, D]
+            out_slabs = lax.all_to_all(
+                out_slabs, self.axis_name, split_axis=0, concat_axis=0,
+                tiled=False,
+            )
+            out_slabs = out_slabs.reshape(E, cap, d)
+
+        # -- combine: weighted gather back to token order ---------------------
+        yt = jnp.einsum("nec,ecd->nd", dispatch, out_slabs) * gate[:, None]
+        if ep > 1:
+            pad = jnp.zeros((n_tok, d), jnp.float32)
+            pad = lax.dynamic_update_slice_in_dim(pad, yt, me * chunk, 0)
+            yt = psum_fwd_identity_bwd(pad, self.axis_name)
+        return (yt.reshape(b, t, d).astype(x.dtype),
+                {"aux": aux} if not state else {**state, "aux": aux})

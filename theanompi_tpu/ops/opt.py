@@ -22,48 +22,52 @@ def _tmap(fn, *trees):
     return jax.tree.map(fn, *trees)
 
 
-def _spec_mentions(spec, axis: str) -> bool:
-    """Does a PartitionSpec place any dim on ``axis``?"""
+def _spec_axes(spec) -> tuple:
+    """All mesh axes a PartitionSpec places dims on."""
     if spec is None:
-        return False
+        return ()
+    axes = []
     for entry in spec:
         if entry is None:
             continue
-        entries = entry if isinstance(entry, tuple) else (entry,)
-        if axis in entries:
-            return True
-    return False
+        for a in (entry if isinstance(entry, tuple) else (entry,)):
+            if a not in axes:
+                axes.append(a)
+    return tuple(axes)
 
 
 def global_sq_norm(grads, param_specs=None):
     """Global squared L2 norm of a gradient pytree, sharding-aware.
 
-    Under tensor parallelism, leaves whose spec shards a dim over the
-    ``model`` axis hold only that shard's slice; their squared norms must be
-    psummed over the axis to get the true global norm (replicated leaves are
-    identical on every shard and must NOT be).  ``param_specs=None`` (or
-    model axis unbound / size 1) degrades to the plain sum.
+    A leaf whose spec shards dims over mesh axes (``model`` under tensor
+    parallelism, ``pipe`` under pipeline parallelism) holds only its
+    shard's slice; its squared norm must be psummed over those axes to get
+    the true global norm (replicated leaves are identical on every shard
+    and must NOT be).  ``param_specs=None`` (or no bound sharding axes)
+    degrades to the plain sum.
     """
-    from theanompi_tpu.parallel.mesh import MODEL_AXIS
     from theanompi_tpu.parallel.tensor import axis_bound
 
     leaves, treedef = jax.tree_util.tree_flatten(grads)
-    if (
-        param_specs is None
-        or not axis_bound(MODEL_AXIS)
-        or jax.lax.axis_size(MODEL_AXIS) == 1
-    ):
+    if param_specs is None:
         return sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
     spec_leaves = treedef.flatten_up_to(param_specs)
-    repl_sq = jnp.zeros((), jnp.float32)
-    shard_sq = jnp.zeros((), jnp.float32)
+    # group per-leaf norms by the exact set of bound sharding axes, then
+    # psum each group over its axes once
+    groups: dict = {}
     for g, spec in zip(leaves, spec_leaves):
+        axes = tuple(sorted(
+            a for a in _spec_axes(spec)
+            if axis_bound(a) and jax.lax.axis_size(a) > 1
+        ))
         s = jnp.sum(jnp.square(g.astype(jnp.float32)))
-        if _spec_mentions(spec, MODEL_AXIS):
-            shard_sq = shard_sq + s
-        else:
-            repl_sq = repl_sq + s
-    return repl_sq + jax.lax.psum(shard_sq, MODEL_AXIS)
+        groups[axes] = groups.get(axes, jnp.zeros((), jnp.float32)) + s
+    total = jnp.zeros((), jnp.float32)
+    for axes, s in groups.items():
+        for a in axes:
+            s = jax.lax.psum(s, a)
+        total = total + s
+    return total
 
 
 def clip_by_global_norm(grads, max_norm: float, param_specs=None):

@@ -13,6 +13,8 @@ Axes convention:
 
 - ``data``  — data parallelism (the reference's only parallelism; one worker
   per reference GPU maps to one slice along this axis),
+- ``pipe``  — pipeline parallelism over stacked homogeneous blocks
+  (see :mod:`theanompi_tpu.parallel.pipeline`),
 - ``model`` — tensor parallelism (beyond reference capability, here from day
   one so shardings compose),
 - ``seq``   — sequence/context parallelism for ring attention
@@ -31,6 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+PIPE_AXIS = "pipe"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 
@@ -62,28 +65,32 @@ def make_mesh(
     n_data: int | None = None,
     n_model: int = 1,
     n_seq: int = 1,
+    n_pipe: int = 1,
     devices: Sequence[Any] | None = None,
 ) -> Mesh:
-    """Build a ``(data, model, seq)`` mesh over the available devices.
+    """Build a ``(data, pipe, model, seq)`` mesh over the available devices.
 
-    ``n_data=None`` consumes all devices left over after ``n_model``/``n_seq``.
+    ``n_data=None`` consumes all devices left over after the other axes.
     A mesh of total size 1 is valid and is the single-worker ("CPU Theano
     mode", BASELINE.md config 1) case.
     """
     if devices is None:
         devices = jax.devices()
     total = len(devices)
+    rest = n_model * n_seq * n_pipe
     if n_data is None:
-        if total % (n_model * n_seq) != 0:
+        if total % rest != 0:
             raise ValueError(
-                f"{total} devices not divisible by model*seq={n_model * n_seq}"
+                f"{total} devices not divisible by pipe*model*seq={rest}"
             )
-        n_data = total // (n_model * n_seq)
-    need = n_data * n_model * n_seq
+        n_data = total // rest
+    need = n_data * rest
     if need > total:
         raise ValueError(f"need {need} devices, have {total}")
-    arr = np.asarray(devices[:need], dtype=object).reshape(n_data, n_model, n_seq)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
+    arr = np.asarray(devices[:need], dtype=object).reshape(
+        n_data, n_pipe, n_model, n_seq
+    )
+    return Mesh(arr, (DATA_AXIS, PIPE_AXIS, MODEL_AXIS, SEQ_AXIS))
 
 
 def mesh_axis_size(mesh: Mesh, axis: str) -> int:

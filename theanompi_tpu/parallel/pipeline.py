@@ -1,0 +1,96 @@
+"""Pipeline parallelism: SPMD GPipe over the ``pipe`` mesh axis.
+
+Beyond the reference's capability set (SURVEY.md §2 — 2016 data parallelism
+only), but part of this framework's scale contract alongside tensor and
+sequence parallelism.  The design is the collective-permute schedule every
+TPU pipeline uses (the stacked-homogeneous-stages form):
+
+- The model's repeated blocks are *stacked*: every block-param leaf carries
+  a leading ``[n_stages, blocks_per_stage, ...]`` axis sharded over
+  ``pipe``, so inside ``shard_map`` each device holds its own stage chunk
+  and the SAME traced program runs on every stage (SPMD — no per-stage
+  programs to compile).
+- Each schedule step, every device applies its stage to the activation it
+  holds, then the activations rotate one hop along the pipe ring
+  (``ppermute``).  Stage 0 injects a fresh microbatch per step; the last
+  stage's outputs accumulate into the output buffer.  ``n_micro + n_stages
+  - 1`` steps drain the pipeline (the classic bubble).
+
+Gradient correctness across the pipe axis uses the same pinned-VJP
+collectives as tensor parallelism (``parallel/tensor.py``): the input is
+wrapped in Megatron-``f`` over ``pipe`` (identity forward, psum backward)
+because only stage 0's injection path carries the embedding cotangent, and
+the output is replicated with Megatron-``g`` (psum forward, identity
+backward) because only the last stage holds real outputs.  Params that are
+NOT pipe-sharded (embeddings, the LM head) therefore get identical
+gradients on every pipe rank, exactly like replicated params under tensor
+parallelism.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from theanompi_tpu.parallel.mesh import PIPE_AXIS
+from theanompi_tpu.parallel.tensor import (
+    axis_bound,
+    identity_fwd_psum_bwd,
+    psum_fwd_identity_bwd,
+)
+
+
+def pipeline_apply(stage_fn, stage_params, x, n_micro: int,
+                   axis_name: str = PIPE_AXIS):
+    """Run ``x`` through the pipelined stages; -> last-stage outputs.
+
+    ``stage_fn(stage_params, act, t) -> act``: applies THIS device's stage
+    chunk (``t`` is the schedule step, for rng folding).  ``stage_params``:
+    the local chunk (leading stage axis of size 1 already squeezed by the
+    caller).  ``x``: [B, ...] activations, replicated across ``pipe``
+    (batch sharding over ``data`` is orthogonal).  ``n_micro`` must divide
+    B.  Outside shard_map (or pipe size 1) this degrades to a plain call.
+    """
+    if not axis_bound(axis_name) or lax.axis_size(axis_name) == 1:
+        return stage_fn(stage_params, x, 0)
+    n_stages = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible by n_micro={n_micro}")
+    mb = b // n_micro
+    xm = identity_fwd_psum_bwd(x, axis_name).reshape(n_micro, mb, *x.shape[1:])
+    ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    steps = n_micro + n_stages - 1
+
+    def body(carry, t):
+        act, outbuf = carry
+        # stage 0 injects microbatch t (clip: once drained it recomputes the
+        # last one — the result never reaches the last stage before the
+        # schedule ends, so it is dead work, not wrong work)
+        inject = xm[jnp.clip(t, 0, n_micro - 1)]
+        x_in = jnp.where(me == 0, inject, act)
+        y = stage_fn(stage_params, x_in, t)
+        # the microbatch index this stage processed at step t
+        m = t - me
+        valid = jnp.logical_and(m >= 0, m < n_micro)
+        is_last = me == n_stages - 1
+        contrib = jnp.where(
+            jnp.logical_and(valid, is_last), y, jnp.zeros_like(y)
+        )
+        # each (m) is written by exactly one (t, last-stage) pair; all other
+        # adds are zeros, so accumulate-add is exact
+        outbuf = outbuf.at[jnp.clip(m, 0, n_micro - 1)].add(
+            contrib.astype(outbuf.dtype))
+        act_next = lax.ppermute(y, axis_name, ring)
+        return (act_next, outbuf), None
+
+    act0 = jnp.zeros((mb, *x.shape[1:]), x.dtype)
+    out0 = jnp.zeros(xm.shape, jnp.float32)
+    (_, outbuf), _ = lax.scan(body, (act0, out0), jnp.arange(steps))
+    outs = outbuf.reshape(b, *x.shape[1:]).astype(x.dtype)
+    # replicate the last stage's outputs to every pipe rank (zeros
+    # elsewhere); pinned backward: the replicated cotangent flows once into
+    # each rank's contrib path, where the valid/is_last select routes it
+    return psum_fwd_identity_bwd(outs, axis_name)

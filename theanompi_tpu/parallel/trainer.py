@@ -464,18 +464,19 @@ class Rule:
     ):
         n_model = self.config.get("n_model", 1)
         n_seq = self.config.get("n_seq", 1)
+        n_pipe = self.config.get("n_pipe", 1)
         if isinstance(devices, int):
             # `devices` is the WORKER (data-parallel) count, as in the
-            # reference API; model/seq axes multiply the device need
-            need = devices * n_model * n_seq
+            # reference API; pipe/model/seq axes multiply the device need
+            need = devices * n_model * n_seq * n_pipe
             mesh = make_mesh(n_data=devices, n_model=n_model, n_seq=n_seq,
-                             devices=jax.devices()[:need])
+                             n_pipe=n_pipe, devices=jax.devices()[:need])
         elif devices is None:
-            mesh = make_mesh(n_model=n_model, n_seq=n_seq)
+            mesh = make_mesh(n_model=n_model, n_seq=n_seq, n_pipe=n_pipe)
         else:
             mesh = make_mesh(
-                n_data=len(devices) // (n_model * n_seq),
-                n_model=n_model, n_seq=n_seq, devices=devices,
+                n_data=len(devices) // (n_model * n_seq * n_pipe),
+                n_model=n_model, n_seq=n_seq, n_pipe=n_pipe, devices=devices,
             )
         n = mesh.shape[DATA_AXIS]
         model_config = dict(model_config or {})
