@@ -93,7 +93,7 @@ def build_trainer(model_name: str, platform: str):
     elif model_name == "transformer":
         from theanompi_tpu.models.transformer_lm import TransformerLM as cls
 
-        bs = int(bs_env) if bs_env else (8 if platform == "tpu" else 2)
+        bs = int(bs_env) if bs_env else (16 if platform == "tpu" else 2)
         seq = int(os.environ.get("BENCH_SEQ", "2048" if platform == "tpu"
                                  else "256"))
         # n_train/n_val count sequences for the PTB synthetic fallback.

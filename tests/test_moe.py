@@ -22,6 +22,7 @@ from theanompi_tpu.parallel.mesh import MODEL_AXIS, make_mesh, shard_map
 CFG = {"batch_size": 8, "n_train": 64, "n_val": 32, "seq_len": 16,
        "vocab": 32, "dim": 32, "heads": 4, "n_layers": 2, "dropout": 0.0,
        "n_experts": 8, "capacity_factor": 8.0,  # = n_experts: no drops
+       "l2": 1e-4,
        "n_epochs": 1, "precision": "fp32"}
 
 

@@ -19,7 +19,8 @@ from theanompi_tpu.parallel.mesh import make_mesh
 
 CFG = {"batch_size": 8, "n_train": 64, "n_val": 32, "seq_len": 16,
        "vocab": 32, "dim": 32, "heads": 4, "n_layers": 4, "dropout": 0.0,
-       "n_micro": 4, "n_epochs": 1, "precision": "fp32"}
+       "n_micro": 4,
+       "l2": 1e-4, "n_epochs": 1, "precision": "fp32"}
 
 
 def _run_steps(mesh, cfg, steps=3):
@@ -68,7 +69,7 @@ def test_pp4_matches_single_device():
     np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
 
 
-def test_pp8_trains_and_validates(mesh8):
+def test_pp8_trains_and_validates():
     """All 8 devices as pipeline stages (dp=1, pp=8): runs + learns-ish."""
     mesh = make_mesh(n_data=1, n_pipe=8)
     cfg = {**CFG, "n_layers": 8, "n_epochs": 2}

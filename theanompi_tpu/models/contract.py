@@ -151,6 +151,15 @@ class SupervisedModel(Model):
         logits, new_state = self.net.apply(params, state, x, train=train, rng=rng)
         return logits, (), new_state
 
+    def l2_sq_norm(self, params):
+        """Squared L2 norm of the params, sharding-aware: leaves whose spec
+        shards mesh axes (pipe-stacked blocks, expert weights) are psummed
+        over those axes so the l2 term — and hence the loss — is replicated
+        on every shard."""
+        from theanompi_tpu.ops.opt import global_sq_norm
+
+        return global_sq_norm(params, self.param_specs(params))
+
     def prepare_x(self, x):
         if x.dtype == jnp.uint8:
             # images travel host->device as uint8 (4x fewer bytes than
@@ -182,11 +191,7 @@ class SupervisedModel(Model):
         if self.config.get("l2", 0.0):
             # reference models folded L2 into the graph cost; weight_decay on
             # the optimizer is the decoupled alternative
-            sq = sum(
-                jnp.sum(jnp.square(p.astype(jnp.float32)))
-                for p in jax.tree.leaves(params)
-            )
-            loss = loss + self.config["l2"] * sq
+            loss = loss + self.config["l2"] * self.l2_sq_norm(params)
         metrics = {
             "cost": loss,
             "error": top_k_error(logits, batch["y"], k=1),

@@ -312,8 +312,10 @@ class PipelineTransformerLM(TransformerLM):
             "head": jax.tree.map(lambda _: P(), params["head"]),
         }
 
-    def loss_fn(self, params, state, batch, rng, train: bool):
-        from theanompi_tpu.ops import softmax_cross_entropy, top_k_error
+    def apply_net(self, params, state, x, *, train, rng):
+        """The pipelined forward; metrics/l2/perplexity stay in the shared
+        ``loss_fn`` path (l2 over the pipe-sharded blocks is handled by the
+        spec-aware ``l2_sq_norm``)."""
         from theanompi_tpu.parallel.pipeline import pipeline_apply
         from theanompi_tpu.parallel.tensor import axis_bound
 
@@ -327,9 +329,8 @@ class PipelineTransformerLM(TransformerLM):
                     f"PipelineTransformerLM does not compose with a sharded"
                     f" {ax!r} axis yet; use n_model=1, n_seq=1"
                 )
-        cp = self.precision.cast_to_compute(params)
-        emb, _ = self._embed.apply(cp["embed"], {}, batch["x"])
-        emb, _ = self._pos.apply(cp["pos"], {}, emb)
+        emb, _ = self._embed.apply(params["embed"], {}, x)
+        emb, _ = self._pos.apply(params["pos"], {}, emb)
 
         def stage_fn(chunk, act, t):
             if rng is None:
@@ -352,31 +353,7 @@ class PipelineTransformerLM(TransformerLM):
             (act, _), _ = jax.lax.scan(one, (act, key0), chunk)
             return act
 
-        h = pipeline_apply(stage_fn, cp["blocks"], emb, cfg["n_micro"])
-        h, _ = self._ln_f.apply(cp["ln_f"], {}, h)
-        logits, _ = self._head.apply(cp["head"], {}, h)
-        y = batch["y"]
-        loss = softmax_cross_entropy(logits, y)
-        if cfg.get("l2", 0.0):
-            # block leaves are pipe-sharded: psum their squared norms so the
-            # l2 term (and hence the loss) is replicated across stages
-            blocks_sq = sum(
-                jnp.sum(jnp.square(p.astype(jnp.float32)))
-                for p in jax.tree.leaves(params["blocks"])
-            )
-            if axis_bound("pipe") and jax.lax.axis_size("pipe") > 1:
-                blocks_sq = jax.lax.psum(blocks_sq, "pipe")
-            other_sq = sum(
-                jnp.sum(jnp.square(p.astype(jnp.float32)))
-                for k in ("embed", "pos", "ln_f", "head")
-                for p in jax.tree.leaves(params[k])
-            )
-            loss = loss + cfg["l2"] * (blocks_sq + other_sq)
-        metrics = {
-            "cost": loss,
-            "error": top_k_error(logits, y, k=1),
-            "error_top5": top_k_error(logits, y, k=5)
-            if logits.shape[-1] >= 5 else jnp.zeros((), jnp.float32),
-            "perplexity": jnp.exp(loss),
-        }
-        return loss, (state, metrics)
+        h = pipeline_apply(stage_fn, params["blocks"], emb, cfg["n_micro"])
+        h, _ = self._ln_f.apply(params["ln_f"], {}, h)
+        logits, _ = self._head.apply(params["head"], {}, h)
+        return logits, (), state

@@ -124,13 +124,18 @@ class MoEFFN(L.Layer):
         onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)
         gate = jnp.sum(probs * onehot, axis=-1)            # [N]
 
-        # load-balance aux loss (Switch eq. 4): E * sum_e f_e * P_e, meaned
-        # over the EP ranks so the stashed value is replicated
+        # load-balance aux loss (Switch eq. 4): E * sum_e f_e * P_e over the
+        # GLOBAL token set.  f and P are pmean'd over the EP ranks BEFORE
+        # combining (chunks are equal-sized, so the pmean is the global
+        # mean): the product is nonlinear, so pmean-ing the per-chunk aux
+        # instead would add a cross-chunk covariance term and silently
+        # change the objective vs the single-device run
         f = jnp.mean(onehot, axis=0)
         p_mean = jnp.mean(probs, axis=0)
-        aux = E * jnp.sum(f * p_mean)
         if ep > 1:
-            aux = lax.pmean(aux, self.axis_name)
+            f = lax.pmean(f, self.axis_name)
+            p_mean = lax.pmean(p_mean, self.axis_name)
+        aux = E * jnp.sum(f * p_mean)
 
         # -- capacity + position ----------------------------------------------
         cap = int(max(1, -(-chunk * self.capacity_factor // E)))
