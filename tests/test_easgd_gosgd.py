@@ -182,6 +182,22 @@ def test_gosgd_e2e(mesh8):
     assert (w > 0).all()
 
 
+@pytest.mark.parametrize("cls_name", ["EASGDTrainer", "GOSGDTrainer"])
+def test_async_rules_refuse_sharded_model_axes(cls_name):
+    """Async rules are data-parallel only: a tp/pp mesh must be refused
+    loudly — their stacked-param layout ignores model param_specs, so TP
+    collectives would silently double-count."""
+    from theanompi_tpu.models.wide_resnet import WideResNet
+    from theanompi_tpu.parallel.easgd import EASGDTrainer
+    from theanompi_tpu.parallel.gosgd import GOSGDTrainer
+    from theanompi_tpu.parallel.mesh import make_mesh
+
+    cls = {"EASGDTrainer": EASGDTrainer, "GOSGDTrainer": GOSGDTrainer}[cls_name]
+    mesh = make_mesh(n_data=2, n_model=2, devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="data-parallel only"):
+        cls(WideResNet({**TINY, "n_epochs": 1}), mesh=mesh)
+
+
 def test_easgd_single_worker_exact_exchange():
     """n=1 elastic exchange is exact: p' = p - a(p-c), c' = c + a(p-c)."""
     from theanompi_tpu.parallel.mesh import make_mesh

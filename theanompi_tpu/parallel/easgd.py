@@ -38,6 +38,7 @@ from theanompi_tpu.parallel.trainer import (
     Rule,
     make_local_eval,
     make_local_step,
+    require_data_parallel_mesh,
     pmean_floats,
     restack,
     stack_for_workers,
@@ -76,6 +77,7 @@ class EASGDTrainer(BaseTrainer):
     def __init__(self, model, mesh=None, tau: int = 4,
                  alpha: float | None = None, **kwargs):
         super().__init__(model, mesh=mesh, **kwargs)
+        require_data_parallel_mesh(self.mesh, "EASGDTrainer")
         self.tau = tau
         self.alpha = alpha if alpha is not None else 0.9 / self.n_workers
         self.center = None

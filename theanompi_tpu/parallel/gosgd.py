@@ -43,6 +43,7 @@ from theanompi_tpu.parallel.trainer import (
     Rule,
     make_local_eval,
     make_local_step,
+    require_data_parallel_mesh,
     pmean_floats,
     restack,
     stack_for_workers,
@@ -100,6 +101,7 @@ class GOSGDTrainer(BaseTrainer):
 
     def __init__(self, model, mesh=None, p_push: float | None = None, **kwargs):
         super().__init__(model, mesh=mesh, **kwargs)
+        require_data_parallel_mesh(self.mesh, "GOSGDTrainer")
         self.p_push = p_push if p_push is not None else 1.0 / max(self.n_workers, 2)
         self.weights = None
         self._gossip_fn = None

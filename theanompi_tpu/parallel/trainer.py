@@ -23,7 +23,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from theanompi_tpu.parallel.mesh import DATA_AXIS, make_mesh, replica_rng
+from theanompi_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    make_mesh,
+    replica_rng,
+)
 from theanompi_tpu.utils.helper_funcs import import_model, shard_batch
 from theanompi_tpu.utils.recorder import Recorder
 
@@ -118,6 +125,24 @@ def make_local_eval(model, axes=DATA_AXIS):
         return pmean_floats(metrics, axes)
 
     return local_eval
+
+
+def require_data_parallel_mesh(mesh, rule_name: str) -> None:
+    """Refuse tp/sp/pp meshes for the async rules (data-parallel only).
+
+    EASGD/GOSGD stack per-worker params over ``data`` and ignore the
+    model's ``param_specs`` — on a mesh with a sharded ``model``/``seq``/
+    ``pipe`` axis, a tensor-parallel layer's collectives would run against
+    replicated full weights and silently double-count (the same bug class
+    the pipeline model guards against).  The reference's async rules were
+    data-parallel only too (SURVEY.md §2.1).
+    """
+    for axis in (MODEL_AXIS, SEQ_AXIS, PIPE_AXIS):
+        if mesh.shape.get(axis, 1) > 1:
+            raise ValueError(
+                f"{rule_name} is data-parallel only: mesh axis {axis!r} has "
+                f"size {mesh.shape[axis]} (use BSP for tp/sp/pp shardings)"
+            )
 
 
 def stack_for_workers(mesh, tree, n: int):
