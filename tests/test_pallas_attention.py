@@ -75,10 +75,22 @@ def test_flash_uneven_blocks():
                                rtol=2e-4, atol=2e-5)
 
 
+def test_t1536_fits_blocks_and_matches():
+    """T divisible by 512 but not the 1024 default block_k: the fitting
+    clamp must halve the block instead of rejecting the shape."""
+    b, t, h, d = 1, 1536, 1, 64
+    q, k, v = (jnp.asarray(_rand((b, t, h, d), 40 + i)) for i in range(3))
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_reference(q, k, v, True)),
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_supported_gate():
     assert flash_attention_supported(256, 64)   # clamps blocks to 256
     assert flash_attention_supported(512, 128)
     assert flash_attention_supported(2048, 64)
+    assert flash_attention_supported(1536, 64)  # block_k fits down to 512
     assert not flash_attention_supported(100, 64)   # ragged T (clamped
     # block 100 is not a multiple of the 128-lane tile)
     assert not flash_attention_supported(256, 8)    # tiny head dim

@@ -42,8 +42,8 @@ class MultiHeadAttention(L.Layer):
     heads: int
     causal: bool = True
     #: "auto" = pallas flash kernels on TPU when shapes allow — for both
-    #: training and inference (measured: train step 2.8x over the XLA
-    #: blockwise path at T=2048, 3.8x at T=8192, and T=16384 trains where
+    #: training and inference (measured: train step ~3.2x over the XLA
+    #: blockwise path at T=2048, ~4.7x at T=8192, and T=16384 trains where
     #: XLA out-of-memories).  "pallas"/"blockwise" force one when the seq
     #: axis is NOT sharded; ring attention always wins under sequence
     #: parallelism.
