@@ -26,16 +26,24 @@ STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
 def pad_crop_mirror(x: np.ndarray, rng: np.random.RandomState, pad: int = 4):
     """Random pad-crop + horizontal mirror (the reference's augmentations).
 
-    Host-side numpy; it runs inside the loader generator, which the
-    para_load-equivalent prefetch thread
-    (:mod:`theanompi_tpu.models.data.prefetch`) overlaps with device compute.
+    Host-side; runs inside the loader generator, which the para_load-
+    equivalent prefetch thread (:mod:`theanompi_tpu.models.data.prefetch`)
+    overlaps with device compute.  The reflect pad vectorizes in numpy;
+    the per-image crop+mirror gather runs in C when available
+    (:mod:`theanompi_tpu.native`), with the numpy loop as the tested
+    reference fallback.
     """
+    from theanompi_tpu import native
+
     n, h, w, c = x.shape
     padded = np.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="reflect")
-    out = np.empty_like(x)
     ys = rng.randint(0, 2 * pad + 1, n)
     xs = rng.randint(0, 2 * pad + 1, n)
     flips = rng.rand(n) < 0.5
+    fast = native.crop_mirror_batch(padded, h, w, ys, xs, flips)
+    if fast is not None:
+        return fast
+    out = np.empty_like(x)
     for i in range(n):
         img = padded[i, ys[i] : ys[i] + h, xs[i] : xs[i] + w]
         out[i] = img[:, ::-1] if flips[i] else img

@@ -34,11 +34,20 @@ STD_RGB = np.array([58.39, 57.12, 57.38], np.float32)
 
 
 def random_crop_mirror(x: np.ndarray, out: int, rng: np.random.RandomState):
-    """Random spatial crop to ``out`` + horizontal mirror (train augment)."""
+    """Random spatial crop to ``out`` + horizontal mirror (train augment).
+
+    The per-image gather runs in C when the native helper is available
+    (:mod:`theanompi_tpu.native`); the numpy loop below is the reference
+    implementation both paths are tested equal against."""
+    from theanompi_tpu import native
+
     n, h, w, _ = x.shape
     ys = rng.randint(0, h - out + 1, n)
     xs = rng.randint(0, w - out + 1, n)
     flips = rng.rand(n) < 0.5
+    fast = native.crop_mirror_batch(x, out, out, ys, xs, flips)
+    if fast is not None:
+        return fast
     res = np.empty((n, out, out, x.shape[3]), x.dtype)
     for i in range(n):
         img = x[i, ys[i] : ys[i] + out, xs[i] : xs[i] + out]
