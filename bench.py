@@ -39,16 +39,18 @@ import os
 import jax
 import jax.numpy as jnp
 
-# Round-1 nominal throughput (images/sec) per (model, platform) — the
-# denominator for vs_baseline.  Backfill real reference numbers if the
-# reference mount is ever fixed.
+# Best prior-round measured throughput per (model, platform) — the
+# denominator for vs_baseline, so driver artifacts track round-over-round
+# progress (VERDICT r2 #9: anchored to the BASELINE.md ladder, not the
+# round-1 guess).  Backfill real reference numbers if the reference mount is
+# ever fixed.
 NOMINAL = {
-    ("wide_resnet", "tpu"): 4000.0,
+    ("wide_resnet", "tpu"): 4000.0,    # round-1 nominal (never re-measured)
     ("wide_resnet", "cpu"): 40.0,
-    ("resnet50", "tpu"): 800.0,
+    ("resnet50", "tpu"): 2473.4,       # round 2, BENCH_r02.json
     ("resnet50", "cpu"): 4.0,
     # transformer rows are tokens/sec (unit switches with the model)
-    ("transformer", "tpu"): 100_000.0,
+    ("transformer", "tpu"): 290_000.0,  # round 2, BASELINE.md ladder
     ("transformer", "cpu"): 1_000.0,
 }
 
@@ -96,12 +98,11 @@ def build_trainer(model_name: str, platform: str):
         bs = int(bs_env) if bs_env else (16 if platform == "tpu" else 2)
         seq = int(os.environ.get("BENCH_SEQ", "2048" if platform == "tpu"
                                  else "256"))
-        # n_train/n_val count sequences for the PTB synthetic fallback.
-        # vocab serves both the model's logits ([B, T, V] fp32 in the loss)
-        # AND the synthetic generator's bigram table (vocab^2 float64 on
-        # host): 2048 keeps the untimed host-side setup to ~32 MB where 8k+
-        # would burn ~0.5 GB and tens of seconds before the timed region
-        cfg = {"batch_size": bs, "seq_len": seq, "vocab": 2048,
+        # BENCH_VOCAB >= 8192 flips the model onto the fused chunked
+        # cross-entropy path (the synthetic generator switches to the
+        # procedural-sparse bigram at >4096, so host setup stays cheap)
+        vocab = int(os.environ.get("BENCH_VOCAB", "2048"))
+        cfg = {"batch_size": bs, "seq_len": seq, "vocab": vocab,
                "dim": 512, "heads": 8, "n_layers": 8, "dropout": 0.0,
                "n_train": bs * 8, "n_val": bs * 2}
     else:

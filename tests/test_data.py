@@ -132,3 +132,23 @@ def test_bsp_with_imagenet_synthetic(mesh8):
     for batch in model.data.train_batches(t.global_batch, 0, seed=0):
         m = t.train_iter(batch, lr=0.01)
     assert m is not None and np.isfinite(float(m["cost"]))
+
+
+def test_synthetic_sequence_large_vocab_sparse():
+    """vocab > dense limit: the procedural-sparse generator — no O(V^2)
+    table, tokens in range, bigram structure learnable (<= 32 distinct
+    successors per token), deterministic across constructions."""
+    from theanompi_tpu.models.data.base import SyntheticSequenceDataset
+
+    d1 = SyntheticSequenceDataset(n_train=64, n_val=8, seq_len=64,
+                                  vocab=32768)
+    d2 = SyntheticSequenceDataset(n_train=64, n_val=8, seq_len=64,
+                                  vocab=32768)
+    assert not hasattr(d1, "_probs")
+    np.testing.assert_array_equal(d1._train, d2._train)
+    assert d1._train.min() >= 0 and d1._train.max() < 32768
+    succ = {}
+    for row in d1._train:
+        for a, b in zip(row[:-1], row[1:]):
+            succ.setdefault(int(a), set()).add(int(b))
+    assert max(len(s) for s in succ.values()) <= 32

@@ -90,3 +90,79 @@ def test_top_k_error():
     labels = jnp.array([0, 2])
     assert float(top_k_error(logits, labels, k=1)) == 0.5
     assert float(top_k_error(logits, labels, k=3)) == 0.0
+
+
+# -- fused LM-head cross entropy ----------------------------------------------
+
+
+def _naive_lm_loss(h, w, b, y):
+    logits = (h @ w.astype(h.dtype) + b.astype(h.dtype)).astype(jnp.float32)
+    return softmax_cross_entropy(logits, y)
+
+
+# (vocab, chunk, t): chunk=8 over t=16 -> 4 genuine chunks; t=13 -> n=26
+# pads to 32 and masks; chunk=None/1024 -> single chunk (both regimes of
+# the scan carry are exercised: cross-chunk dw/db/lse accumulation AND the
+# degenerate one-chunk path)
+@pytest.mark.parametrize("vocab,chunk,t", [(37, 8, 16), (37, 8, 13),
+                                           (64, None, 16), (64, 1024, 16)])
+def test_fused_lm_xent_matches_naive_fp32(vocab, chunk, t):
+    """Loss, metrics, and ALL grads (h, w, b) must match the naive
+    [N, V]-materializing path — fwd+bwd equivalence (VERDICT r2 #3)."""
+    from theanompi_tpu.ops.losses import fused_lm_xent
+
+    r = np.random.RandomState(0)
+    bsz, d = 2, 12
+    h = jnp.asarray(r.randn(bsz, t, d).astype(np.float32))
+    w = jnp.asarray(r.randn(d, vocab).astype(np.float32) * 0.2)
+    b = jnp.asarray(r.randn(vocab).astype(np.float32) * 0.1)
+    y = jnp.asarray(r.randint(0, vocab, size=(bsz, t)))
+
+    def fused(h, w, b):
+        return fused_lm_xent(h, w, b, y, chunk_tokens=chunk)[0]
+
+    def naive(h, w, b):
+        return _naive_lm_loss(h, w, b, y)
+
+    lf, gf = jax.value_and_grad(fused, argnums=(0, 1, 2))(h, w, b)
+    ln, gn = jax.value_and_grad(naive, argnums=(0, 1, 2))(h, w, b)
+    np.testing.assert_allclose(float(lf), float(ln), rtol=1e-5)
+    for a, bb, name in zip(gf, gn, ("dh", "dw", "db")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=1e-4, atol=1e-6, err_msg=name)
+
+    # error metrics ride the same pass and must equal top_k_error
+    logits = h @ w + b
+    _, e1, e5 = fused_lm_xent(h, w, b, y, chunk_tokens=chunk)
+    np.testing.assert_allclose(float(e1), float(top_k_error(logits, y, k=1)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(e5), float(top_k_error(logits, y, k=5)),
+                               rtol=1e-6)
+
+
+def test_fused_lm_xent_bf16_close_to_naive():
+    """bf16 inputs: the fused path accumulates scores in fp32 on the MXU, so
+    it may only be MORE accurate than the naive bf16-logit path; assert
+    agreement at bf16 tolerance."""
+    from theanompi_tpu.ops.losses import fused_lm_xent
+
+    r = np.random.RandomState(1)
+    h = jnp.asarray(r.randn(2, 8, 16).astype(np.float32)).astype(jnp.bfloat16)
+    w = jnp.asarray((r.randn(16, 96) * 0.2).astype(np.float32)).astype(jnp.bfloat16)
+    b = jnp.zeros((96,), jnp.bfloat16)
+    y = jnp.asarray(r.randint(0, 96, size=(2, 8)))
+    lf = float(fused_lm_xent(h, w, b, y)[0])
+    ln = float(_naive_lm_loss(h, w, b, y))
+    assert abs(lf - ln) / max(abs(ln), 1e-6) < 2e-2
+
+
+def test_fused_lm_xent_no_bias():
+    from theanompi_tpu.ops.losses import fused_lm_xent
+
+    r = np.random.RandomState(2)
+    h = jnp.asarray(r.randn(1, 8, 8).astype(np.float32))
+    w = jnp.asarray(r.randn(8, 32).astype(np.float32))
+    y = jnp.asarray(r.randint(0, 32, size=(1, 8)))
+    lf = float(fused_lm_xent(h, w, None, y)[0])
+    ln = float(_naive_lm_loss(h, w, jnp.zeros((32,)), y))
+    np.testing.assert_allclose(lf, ln, rtol=1e-5)

@@ -100,26 +100,53 @@ class SyntheticSequenceDataset(Dataset):
     to learn (perplexity can drop well below vocab size).
     """
 
-    def __init__(self, n_train=512, n_val=128, seq_len=32, vocab=64, seed=0):
+    def __init__(self, n_train=512, n_val=128, seq_len=32, vocab=64, seed=0,
+                 dense_vocab_limit=4096):
         rng = np.random.RandomState(seed)
         self.vocab = vocab
         self.n_classes = vocab
         self.seq_len = seq_len
         self.sample_shape = (seq_len,)
-        # peaked bigram transition table
-        logits = rng.randn(vocab, vocab) * 2.0
-        probs = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
-        self._probs = probs
+        if vocab <= dense_vocab_limit:
+            # peaked bigram transition table
+            logits = rng.randn(vocab, vocab) * 2.0
+            probs = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+            self._probs = probs
 
-        def gen(n, r):
-            seqs = np.zeros((n, seq_len + 1), np.int32)
-            seqs[:, 0] = r.randint(0, vocab, n)
-            for t in range(seq_len):
-                cur = seqs[:, t]
-                u = r.rand(n, 1)
-                cdf = probs[cur].cumsum(1)
-                seqs[:, t + 1] = (u > cdf).sum(1)
-            return seqs
+            def gen(n, r):
+                seqs = np.zeros((n, seq_len + 1), np.int32)
+                seqs[:, 0] = r.randint(0, vocab, n)
+                for t in range(seq_len):
+                    cur = seqs[:, t]
+                    u = r.rand(n, 1)
+                    cdf = probs[cur].cumsum(1)
+                    # clamp: float cumsum can top out below 1.0, and a draw
+                    # above it would index one past the last class
+                    seqs[:, t + 1] = np.minimum((u > cdf).sum(1), vocab - 1)
+                return seqs
+        else:
+            # Large-vocab (32k-class LM benches): the dense table is O(V^2)
+            # — 8 GB at V=32k — so transitions go procedural-sparse instead:
+            # every token has S successors at (a*cur + c + j*j) % V, drawn
+            # from ONE shared peaked categorical over j.  O(S) memory, still
+            # bigram-learnable (entropy exp(H(w)) << V).
+            s_succ = 32
+            a = 2 * rng.randint(1, vocab // 2) + 1  # odd -> bijective map
+            c = rng.randint(vocab)
+            wl = np.sort(rng.randn(s_succ) * 2.0)[::-1]
+            w = np.exp(wl) / np.exp(wl).sum()
+            cdf = w.cumsum()
+
+            def gen(n, r):
+                seqs = np.zeros((n, seq_len + 1), np.int32)
+                seqs[:, 0] = r.randint(0, vocab, n)
+                j2 = np.arange(s_succ, dtype=np.int64) ** 2
+                for t in range(seq_len):
+                    cur = seqs[:, t].astype(np.int64)
+                    # same clamp as the dense branch: cdf[-1] can be < 1.0
+                    j = np.minimum((r.rand(n, 1) > cdf).sum(1), s_succ - 1)
+                    seqs[:, t + 1] = (a * cur + c + j2[j]) % vocab
+                return seqs
 
         self._train = gen(n_train, np.random.RandomState(seed + 1))
         self._val = gen(n_val, np.random.RandomState(seed + 2))

@@ -152,6 +152,10 @@ class TransformerLM(SupervisedModel):
                       attn_impl=cfg["attn_impl"])
 
     def build_net(self):
+        """The TRUNK only (embed … final LN).  The LM head lives outside the
+        Sequential so the loss can fuse the head matmul into a chunked
+        cross entropy (``ops.losses.fused_lm_xent``) instead of
+        materializing ``[B, T, V]`` fp32 logits — ruinous at real vocab."""
         cfg = self.config
         layers: list[L.Layer] = [
             L.Embedding(self.data.vocab, cfg["dim"],
@@ -160,11 +164,31 @@ class TransformerLM(SupervisedModel):
         ]
         for _ in range(cfg["n_layers"]):
             layers.append(self._make_block())
-        layers += [
-            L.LayerNorm(),
-            L.Dense(self.data.vocab, w_init=init_lib.glorot_normal),
-        ]
+        layers.append(L.LayerNorm())
+        self._head = L.Dense(self.data.vocab, w_init=init_lib.glorot_normal)
         return L.Sequential(layers), (cfg["seq_len"],)
+
+    def init_params(self, rng):
+        k_trunk, k_head = jax.random.split(rng)
+        params, state, out_shape = self.net.init(k_trunk, self.in_shape)
+        self._out_shape = out_shape
+        head_p, _, _ = self._head.init(k_head, out_shape)
+        # flat Sequential tree + a top-level "head" key: TP rules and tests
+        # address trunk leaves by their Sequential names unchanged
+        params["head"] = head_p
+        return params, state
+
+    def apply_trunk(self, params, state, x, *, train, rng):
+        """-> (hidden states [B, T, D], new_state); variants (pipeline)
+        override this, keeping head+loss in one shared path."""
+        trunk = {k: v for k, v in params.items() if k != "head"}
+        return self.net.apply(trunk, state, x, train=train, rng=rng)
+
+    def fused_loss_enabled(self) -> bool:
+        mode = self.config.get("fused_loss", "auto")
+        if mode == "auto":
+            return self.data.vocab >= 8192
+        return bool(mode)
 
     # -- sharding ------------------------------------------------------------
     def param_specs(self, params):
@@ -181,11 +205,26 @@ class TransformerLM(SupervisedModel):
         return (DATA_AXIS,)
 
     def loss_fn(self, params, state, batch, rng, train: bool):
-        loss, (new_state, metrics) = super().loss_fn(
-            params, state, batch, rng, train
-        )
-        metrics = dict(metrics)
-        metrics["perplexity"] = jnp.exp(metrics["cost"])
+        from theanompi_tpu.ops.losses import fused_lm_xent
+
+        from theanompi_tpu.ops import softmax_cross_entropy, top_k_error
+
+        cp = self.precision.cast_to_compute(params)
+        h, new_state = self.apply_trunk(cp, state, batch["x"],
+                                        train=train, rng=rng)
+        w, b = cp["head"]["w"], cp["head"].get("b")
+        if self.fused_loss_enabled():
+            loss, err1, err5 = fused_lm_xent(h, w, b, batch["y"])
+        else:
+            logits, _ = self._head.apply(cp["head"], {}, h)
+            loss = softmax_cross_entropy(logits, batch["y"])
+            err1 = top_k_error(logits, batch["y"], k=1)
+            err5 = (top_k_error(logits, batch["y"], k=5)
+                    if logits.shape[-1] >= 5 else jnp.zeros((), jnp.float32))
+        if self.config.get("l2", 0.0):
+            loss = loss + self.config["l2"] * self.l2_sq_norm(params)
+        metrics = {"cost": loss, "error": err1, "error_top5": err5,
+                   "perplexity": jnp.exp(loss)}
         return loss, (new_state, metrics)
 
 
@@ -312,10 +351,10 @@ class PipelineTransformerLM(TransformerLM):
             "head": jax.tree.map(lambda _: P(), params["head"]),
         }
 
-    def apply_net(self, params, state, x, *, train, rng):
-        """The pipelined forward; metrics/l2/perplexity stay in the shared
-        ``loss_fn`` path (l2 over the pipe-sharded blocks is handled by the
-        spec-aware ``l2_sq_norm``)."""
+    def apply_trunk(self, params, state, x, *, train, rng):
+        """The pipelined forward up to the final LN; head+loss stay in the
+        shared ``loss_fn`` path (l2 over the pipe-sharded blocks is handled
+        by the spec-aware ``l2_sq_norm``)."""
         from theanompi_tpu.parallel.pipeline import pipeline_apply
         from theanompi_tpu.parallel.tensor import axis_bound
 
@@ -355,5 +394,4 @@ class PipelineTransformerLM(TransformerLM):
 
         h = pipeline_apply(stage_fn, params["blocks"], emb, cfg["n_micro"])
         h, _ = self._ln_f.apply(params["ln_f"], {}, h)
-        logits, _ = self._head.apply(params["head"], {}, h)
-        return logits, (), state
+        return h, state

@@ -7,8 +7,12 @@ categorical error; top-1/top-5 error tracked AlexNet-paper metrics.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax import lax
 
 
 def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
@@ -30,6 +34,133 @@ def sigmoid_binary_cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.A
     return jnp.mean(
         jnp.maximum(logits, 0.0) - logits * targets + jnp.log1p(jnp.exp(-jnp.abs(logits)))
     )
+
+
+# ---------------------------------------------------------------------------
+# fused LM head + cross entropy (chunked — never materializes [N, V] fp32)
+# ---------------------------------------------------------------------------
+#
+# The LM hot path's last un-TPU-native op: ``Dense head -> fp32 softmax CE``
+# materializes [B, T, V] logits in fp32 — at T=2048, B=16, V=32768 that is
+# 4 GB of HBM traffic per direction, which dwarfs the attention the Pallas
+# kernels just optimized.  This path fuses the head matmul into the loss and
+# streams the logits in token chunks: each chunk's [C, V] fp32 scores live
+# only transiently inside one scan step (tens of MB at V=32k — HBM-cheap and
+# never part of the residual set), the per-token logsumexp ([N] fp32) is the
+# ONLY O(N) residual, and the backward recomputes chunk scores from
+# (h, w, lse) — the same rematerialization trade flash attention makes for
+# the [T, T] score matrix.  Top-1/top-5 error ride in the same forward pass
+# so metrics don't re-run the head.  Token counts that don't divide the
+# chunk are zero-padded and masked, so any chunk size serves any N.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _lm_xent(h3, w, b, y2, mask2, cfg):
+    loss, e1, e5, _ = _lm_xent_scan(h3, w, b, y2, mask2, cfg)
+    return loss, e1, e5
+
+
+def _chunk_scores(hc, w, b):
+    """One chunk's fp32 scores [C, V]: bf16 MXU matmul, fp32 accumulate."""
+    s = lax.dot_general(hc, w.astype(hc.dtype), (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    return s + b.astype(jnp.float32)
+
+
+def _lm_xent_scan(h3, w, b, y2, mask2, cfg):
+    n, v = cfg
+
+    def body(carry, xs):
+        hc, yc, mc = xs
+        s = _chunk_scores(hc, w, b)
+        m = jnp.max(s, axis=-1)
+        lse = m + jnp.log(jnp.sum(jnp.exp(s - m[:, None]), axis=-1))
+        gold = jnp.take_along_axis(s, yc[:, None], axis=-1)[:, 0]
+        # >= rank: ties score against the model (same rule as top_k_error)
+        rank = jnp.sum(s >= gold[:, None], axis=-1) - 1
+        mf = mc.astype(jnp.float32)
+        ls, c1, c5 = carry
+        return (
+            ls + jnp.sum((lse - gold) * mf),
+            c1 + jnp.sum((rank >= 1).astype(jnp.float32) * mf),
+            c5 + jnp.sum((rank >= 5).astype(jnp.float32) * mf),
+        ), lse
+
+    zero = jnp.zeros((), jnp.float32)
+    (ls, c1, c5), lse2 = lax.scan(body, (zero, zero, zero), (h3, y2, mask2))
+    return ls / n, c1 / n, c5 / n, lse2
+
+
+def _lm_xent_fwd(h3, w, b, y2, mask2, cfg):
+    loss, e1, e5, lse2 = _lm_xent_scan(h3, w, b, y2, mask2, cfg)
+    return (loss, e1, e5), (h3, w, b, y2, mask2, lse2)
+
+
+def _lm_xent_bwd(cfg, res, cts):
+    h3, w, b, y2, mask2, lse2 = res
+    n, v = cfg
+    g = cts[0] / n  # error cotangents drop: step functions, zero-grad a.e.
+    ids = jnp.arange(v, dtype=y2.dtype)
+
+    def body(carry, xs):
+        hc, yc, mc, lsec = xs
+        s = _chunk_scores(hc, w, b)
+        p = jnp.exp(s - lsec[:, None])
+        dl = (p - (yc[:, None] == ids[None, :])) * (g * mc[:, None])
+        dlc = dl.astype(hc.dtype)  # bf16 for the MXU, like the naive path
+        dh = lax.dot_general(dlc, w.astype(dlc.dtype),
+                             (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        dw_acc, db_acc = carry
+        dw_acc = dw_acc + lax.dot_general(
+            hc, dlc, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        db_acc = db_acc + jnp.sum(dl, axis=0)
+        return (dw_acc, db_acc), dh
+
+    dw0 = jnp.zeros(w.shape, jnp.float32)
+    db0 = jnp.zeros(b.shape, jnp.float32)
+    (dw, db), dh3 = lax.scan(body, (dw0, db0), (h3, y2, mask2, lse2))
+    f0 = jax.dtypes.float0
+    return (dh3.astype(h3.dtype), dw.astype(w.dtype), db.astype(b.dtype),
+            np.zeros(y2.shape, f0), np.zeros(mask2.shape, f0))
+
+
+_lm_xent.defvjp(_lm_xent_fwd, _lm_xent_bwd)
+
+
+def fused_lm_xent(h: jax.Array, w: jax.Array, b: jax.Array | None,
+                  labels: jax.Array, chunk_tokens: int | None = None):
+    """Fused LM-head softmax cross entropy -> ``(loss, top1_err, top5_err)``.
+
+    ``h``: trunk output ``[..., D]``; ``w``: head weight ``[D, V]``; ``b``:
+    head bias ``[V]`` or None; ``labels``: int ids matching ``h``'s leading
+    dims.  Logits are computed in fp32-accumulated token chunks and never
+    stored; backward recomputes them from the saved per-token logsumexp.
+    The default chunk targets ~8 MB of transient fp32 scores, floored at
+    256 tokens so the per-chunk matmul keeps the MXU fed (at V=32k that
+    floor means ~32 MB transient — still nothing against the 4 GB the
+    naive path materializes).  N is zero-padded to the chunk and masked,
+    so no divisibility is required of the caller.
+    """
+    d = h.shape[-1]
+    v = w.shape[-1]
+    h2 = h.reshape(-1, d)
+    y1 = labels.reshape(-1)
+    n = h2.shape[0]
+    if chunk_tokens is None:
+        chunk_tokens = max(256, (8 << 20) // max(4 * v, 1))
+    c = max(8, min(n, chunk_tokens))
+    nc = -(-n // c)
+    pad = nc * c - n
+    if pad:
+        h2 = jnp.concatenate([h2, jnp.zeros((pad, d), h2.dtype)])
+        y1 = jnp.concatenate([y1, jnp.zeros((pad,), y1.dtype)])
+    mask = (jnp.arange(nc * c) < n)
+    if b is None:
+        b = jnp.zeros((v,), jnp.float32)
+    return _lm_xent(h2.reshape(nc, c, d), w, b, y1.reshape(nc, c),
+                    mask.reshape(nc, c), (n, v))
 
 
 def top_k_error(logits: jax.Array, labels: jax.Array, k: int = 1) -> jax.Array:
