@@ -105,6 +105,8 @@ def build_trainer(model_name: str, platform: str):
         cfg = {"batch_size": bs, "seq_len": seq, "vocab": vocab,
                "dim": 512, "heads": 8, "n_layers": 8, "dropout": 0.0,
                "n_train": bs * 8, "n_val": bs * 2}
+        if "BENCH_FUSED_LOSS" in os.environ:
+            cfg["fused_loss"] = bool(int(os.environ["BENCH_FUSED_LOSS"]))
     else:
         from theanompi_tpu.models.wide_resnet import WideResNet as cls
 
@@ -124,9 +126,7 @@ def build_trainer(model_name: str, platform: str):
 def step_flops(trainer, batch) -> float | None:
     """FLOPs per compiled train step, from XLA's cost analysis."""
     try:
-        args = (trainer.params, trainer.state, trainer.opt_state, batch,
-                jnp.float32(0.01), jnp.int32(0))
-        analysis = trainer._step_fn.lower(*args).compile().cost_analysis()
+        analysis = trainer.compiled_step(batch).cost_analysis()
         if isinstance(analysis, list):  # older jax: one dict per device
             analysis = analysis[0]
         fl = float(analysis.get("flops", 0.0))
@@ -165,6 +165,21 @@ def main():
             flops = 6.0 * tree_count(trainer.params) * bs * model.config["seq_len"]
         else:
             flops = ANALYTIC_FLOPS.get(model_name, 0.0) * bs
+    elif model_name == "transformer" and platform == "tpu":
+        # XLA's cost analysis counts Pallas custom-calls as ZERO flops, so
+        # the attention math (ROOFLINE_transformer.json: ~half the step)
+        # vanishes from MFU when the flash kernels are in use.  Add the
+        # analytic causal attention flops: fwd = 0.5 (causal) * 4*B*H*T^2*Dh
+        # per layer, train total = 3.5x fwd (bwd recomputes s and runs
+        # dq/dkv).
+        from theanompi_tpu.ops.pallas_attention import flash_attention_supported
+
+        cfgm = model.config
+        t, dh = cfgm["seq_len"], cfgm["dim"] // cfgm["heads"]
+        if (cfgm.get("attn_impl", "auto") in ("auto", "pallas")
+                and flash_attention_supported(t, dh)):
+            flops += (cfgm["n_layers"] * 3.5 * 0.5 * 4.0
+                      * bs * cfgm["heads"] * t * t * dh)
     peak = chip_peak_flops()
 
     if feed_mode == "placed":

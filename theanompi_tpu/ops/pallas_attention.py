@@ -21,8 +21,19 @@ out-of-memory.  Block size is the whole game — the same kernels at
 (128,128) LOSE to XLA; small tiles drown in DMA latency.  Short
 sequences clamp the blocks down automatically.
 
-The causal loop skips tiles strictly above the diagonal via ``pl.when``
-(their DMA still happens — acceptable; their MXU work does not).
+The causal loop skips tiles strictly above the diagonal twice over: their
+MXU work is gated off with ``pl.when``, and their K/V (resp. q/dO) DMA is
+elided by clamping the streamed operand's ``index_map`` at the diagonal —
+Mosaic's pipeline skips the copy when consecutive steps reference the same
+block, so masked tiles are never fetched from HBM.  Measured effect
+(interleaved A/B vs the round-2 kernels, wide-spread slope protocol):
+neutral at T<=8192 — the kernels are VPU/softmax-bound there and DMA fully
+overlaps — and 1.10x at T=16384 where the K/V streams start to matter.
+Per-component bisect at T=8192 (B2/H8/D64, fwd): matmuls+DMA 0.77 ms,
++max/exp 1.75 ms, full online-softmax 2.8 ms — the softmax VPU chain, not
+the MXU or HBM, is the kernel's floor; exp2 tricks and parallel
+dimension_semantics both measured SLOWER, and the only cheap win kept is
+the scale folded onto the small q tile instead of the full score matrix.
 ``interpret=True`` runs the same kernels on CPU for tests; on TPU the
 Mosaic compiler takes them.  T must divide by ``block_q``/``block_k`` and
 the row-vector transport tiles require ``block_q % 128 == 0`` on TPU
@@ -47,6 +58,26 @@ def _tile_needed(qi, ki, block_q, block_k, causal):
     return (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
 
 
+def _last_needed_k(qi, block_q, block_k):
+    """Last k-tile index with visible keys for q-tile ``qi`` (causal)."""
+    return (qi * block_q + block_q - 1) // block_k
+
+
+def _first_needed_q(ki, block_q, block_k):
+    """First q-tile index that can see k-tile ``ki`` (causal)."""
+    return (ki * block_k) // block_q
+
+
+# Causal DMA elision: Mosaic's pipeline only issues a copy when an operand's
+# block index CHANGES between consecutive grid steps.  Clamping the streamed
+# operand's index_map to the last/first tile the causal mask can ever need
+# makes every masked-tile iteration re-reference the previous tile — so
+# tiles strictly above the diagonal are never fetched from HBM at all
+# (previously only their MXU work was skipped; their K/V DMA still burned
+# ~2x bandwidth at long T).  Compute stays gated on the REAL program ids
+# via ``_tile_needed``, so numerics are untouched.
+
+
 def _causal_tile_mask(qi, ki, block_q, block_k):
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
@@ -65,6 +96,29 @@ def _dot(a, b, dims):
 # ---------------------------------------------------------------------------
 
 
+def _tile_full(qi, ki, block_q, block_k):
+    """Tile entirely below the diagonal: every key visible, no mask ops."""
+    return qi * block_q >= ki * block_k + block_k - 1
+
+
+def _when_causal_tiles(causal, qi, ki, block_q, block_k, body):
+    """Run ``body(masked: bool)`` per tile, splitting full from diagonal.
+
+    Only diagonal-straddling tiles pay the mask's VPU cost (2 iotas +
+    compare + 2 selects over block_q x block_k fp32) — on the old
+    every-tile mask that elementwise work rivaled the matmuls themselves.
+    Non-causal runs the unmasked body unconditionally; above-diagonal
+    tiles run nothing (and their DMA is elided via the clamped index_map).
+    """
+    if not causal:
+        body(False)
+        return
+    needed = _tile_needed(qi, ki, block_q, block_k, True)
+    full = _tile_full(qi, ki, block_q, block_k)
+    pl.when(jnp.logical_and(needed, full))(lambda: body(False))
+    pl.when(jnp.logical_and(needed, jnp.logical_not(full)))(lambda: body(True))
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, scale, causal, block_q, block_k):
     qi, ki = pl.program_id(2), pl.program_id(3)
@@ -76,29 +130,34 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:, :] = jnp.zeros_like(l_scr[:, :])
         acc_scr[:, :] = jnp.zeros_like(acc_scr[:, :])
 
-    # causal: tiles strictly above the diagonal have no visible keys
-    needed = _tile_needed(qi, ki, block_q, block_k, causal)
-
-    @pl.when(needed)
-    def _():
-        q = q_ref[0, 0].astype(jnp.float32)
-        kb = k_ref[0, 0].astype(jnp.float32)
-        vb = v_ref[0, 0].astype(jnp.float32)
-        s = _dot(q, kb, ((1,), (1,))) * scale
-        if causal:
+    def body(masked: bool):
+        # matmul operands stay in the INPUT dtype (bf16 on the training
+        # path) with fp32 MXU accumulation — upcasting first would run the
+        # MXU at its ~8x-slower fp32 rate.  The softmax scale rides on the
+        # small [block_q, d] q tile, not the [block_q, block_k] scores —
+        # the kernels are VPU-bound, so every full-scores elementwise pass
+        # dropped is wall time (profiled: ~46% of the LM step is here).
+        q = q_ref[0, 0] * jnp.asarray(scale, q_ref.dtype)
+        kb = k_ref[0, 0]
+        vb = v_ref[0, 0]
+        s = _dot(q, kb, ((1,), (1,)))
+        if masked:
             mask = _causal_tile_mask(qi, ki, block_q, block_k)
             s = jnp.where(mask, s, _NEG_INF)
         m_prev = m_scr[:, 0]
         l_prev = l_scr[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
-        if causal:
+        if masked:
             p = jnp.where(mask, p, 0.0)  # exp(0)=1 hazard on masked rows
         corr = jnp.exp(m_prev - m_new)
         m_scr[:, :] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
         l_scr[:, :] = jnp.broadcast_to(
             (l_prev * corr + jnp.sum(p, axis=-1))[:, None], l_scr.shape)
-        acc_scr[:, :] = acc_scr[:, :] * corr[:, None] + _dot(p, vb, ((1,), (0,)))
+        acc_scr[:, :] = (acc_scr[:, :] * corr[:, None]
+                         + _dot(p.astype(vb.dtype), vb, ((1,), (0,))))
+
+    _when_causal_tiles(causal, qi, ki, block_q, block_k, body)
 
     @pl.when(ki == nk - 1)
     def _():
@@ -120,16 +179,20 @@ def _fwd_call(q, k, v, *, causal, block_q, block_k, interpret):
     nq, nk = t // block_q, t // block_k
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k)
+
+    def kv_map(bi, hi, qi, ki):
+        if causal:  # masked tiles re-reference the diagonal tile: DMA elided
+            ki = jnp.minimum(ki, _last_needed_k(qi, block_q, block_k))
+        return (bi, hi, ki, 0)
+
     return pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), kv_map),
+            pl.BlockSpec((1, 1, block_k, d), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d),
@@ -164,27 +227,28 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _():
         dq_scr[:, :] = jnp.zeros_like(dq_scr[:, :])
 
-    needed = _tile_needed(qi, ki, block_q, block_k, causal)
-
-    @pl.when(needed)
-    def _():
-        q = q_ref[0, 0].astype(jnp.float32)
-        kb = k_ref[0, 0].astype(jnp.float32)
-        vb = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+    def body(masked: bool):
+        # scale rides on the small q tile (for s) and the final dq write —
+        # never on [block_q, block_k] tensors (VPU-bound kernel)
+        q = q_ref[0, 0] * jnp.asarray(scale, q_ref.dtype)
+        kb = k_ref[0, 0]
+        vb = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0, 0, 0, :]
         delta = delta_ref[0, 0, 0, 0, :]
-        s = _dot(q, kb, ((1,), (1,))) * scale
+        s = _dot(q, kb, ((1,), (1,)))
         p = jnp.exp(s - lse[:, None])
-        if causal:
+        if masked:
             p = jnp.where(_causal_tile_mask(qi, ki, block_q, block_k), p, 0.0)
         dp = _dot(do, vb, ((1,), (1,)))
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p * (dp - delta[:, None])).astype(kb.dtype)  # scale deferred
         dq_scr[:, :] = dq_scr[:, :] + _dot(ds, kb, ((1,), (0,)))
+
+    _when_causal_tiles(causal, qi, ki, block_q, block_k, body)
 
     @pl.when(ki == nk - 1)
     def _():
-        dq_ref[0, 0] = dq_scr[:, :].astype(dq_ref.dtype)
+        dq_ref[0, 0] = (dq_scr[:, :] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -198,24 +262,26 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:, :] = jnp.zeros_like(dk_scr[:, :])
         dv_scr[:, :] = jnp.zeros_like(dv_scr[:, :])
 
-    needed = _tile_needed(qi, ki, block_q, block_k, causal)
-
-    @pl.when(needed)
-    def _():
-        qt = q_ref[0, 0].astype(jnp.float32)
-        kb = k_ref[0, 0].astype(jnp.float32)
-        vb = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+    def body(masked: bool):
+        # the SCALED q tile serves both s and the dk accumulation:
+        # dk = scale * sum(ds_unscaled^T @ q) == sum(ds_unscaled^T @ (q*scale)),
+        # so no full-scores scale pass and no corrective write either
+        qt = q_ref[0, 0] * jnp.asarray(scale, q_ref.dtype)
+        kb = k_ref[0, 0]
+        vb = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0, 0, 0, :]
         delta = delta_ref[0, 0, 0, 0, :]
-        s = _dot(qt, kb, ((1,), (1,))) * scale
+        s = _dot(qt, kb, ((1,), (1,)))
         p = jnp.exp(s - lse[:, None])
-        if causal:
+        if masked:
             p = jnp.where(_causal_tile_mask(qi, ki, block_q, block_k), p, 0.0)
-        dv_scr[:, :] = dv_scr[:, :] + _dot(p, do, ((0,), (0,)))
+        dv_scr[:, :] = dv_scr[:, :] + _dot(p.astype(do.dtype), do, ((0,), (0,)))
         dp = _dot(do, vb, ((1,), (1,)))
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p * (dp - delta[:, None])).astype(qt.dtype)
         dk_scr[:, :] = dk_scr[:, :] + _dot(ds, qt, ((0,), (0,)))
+
+    _when_causal_tiles(causal, qi, ki, block_q, block_k, body)
 
     @pl.when(qi == nq - 1)
     def _():
@@ -232,10 +298,14 @@ def _bwd_call(q, k, v, out, lse, g, *, causal, block_q, block_k, interpret):
     delta = jnp.broadcast_to(
         delta.reshape(b, h, nq, 1, block_q), (b, h, nq, 8, block_q))
 
+    def kv_map(bi, hi, qi, ki):
+        if causal:  # masked tiles re-reference the diagonal tile: DMA elided
+            ki = jnp.minimum(ki, _last_needed_k(qi, block_q, block_k))
+        return (bi, hi, ki, 0)
+
     q_tile = pl.BlockSpec((1, 1, block_q, d),
                           lambda bi, hi, qi, ki: (bi, hi, qi, 0))
-    k_tile = pl.BlockSpec((1, 1, block_k, d),
-                          lambda bi, hi, qi, ki: (bi, hi, ki, 0))
+    k_tile = pl.BlockSpec((1, 1, block_k, d), kv_map)
     row_q = pl.BlockSpec((1, 1, 1, 8, block_q),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0, 0))
     dq = pl.pallas_call(
@@ -249,13 +319,23 @@ def _bwd_call(q, k, v, out, lse, g, *, causal, block_q, block_k, interpret):
         interpret=interpret,
     )(q, k, v, g, lse, delta)
 
-    # grid transposed: k-tile outer, q-tile inner (the accumulated axis)
-    q_tile2 = pl.BlockSpec((1, 1, block_q, d),
-                           lambda bi, hi, ki, qi: (bi, hi, qi, 0))
+    # grid transposed: k-tile outer, q-tile inner (the accumulated axis).
+    # Causal clamp runs the OTHER way here: q-tiles before the diagonal
+    # re-reference the first visible one.
+    def q_map(bi, hi, ki, qi):
+        if causal:
+            qi = jnp.maximum(qi, _first_needed_q(ki, block_q, block_k))
+        return (bi, hi, qi, 0)
+
+    def row_map(bi, hi, ki, qi):
+        if causal:
+            qi = jnp.maximum(qi, _first_needed_q(ki, block_q, block_k))
+        return (bi, hi, qi, 0, 0)
+
+    q_tile2 = pl.BlockSpec((1, 1, block_q, d), q_map)
     k_tile2 = pl.BlockSpec((1, 1, block_k, d),
                            lambda bi, hi, ki, qi: (bi, hi, ki, 0))
-    row_q2 = pl.BlockSpec((1, 1, 1, 8, block_q),
-                          lambda bi, hi, ki, qi: (bi, hi, qi, 0, 0))
+    row_q2 = pl.BlockSpec((1, 1, 1, 8, block_q), row_map)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k),

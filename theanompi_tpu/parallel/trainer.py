@@ -214,6 +214,20 @@ class BaseTrainer:
         """-> (params, state) to evaluate with (replicated)."""
         return self.params, self.state
 
+    def compiled_step(self, batch):
+        """The compiled train-step executable (serves ``.cost_analysis()``
+        and ``.as_text()`` for bench/roofline tooling without each caller
+        re-deriving the argument tuple)."""
+        import jax.numpy as jnp
+
+        args = (self.params, self.state, self.opt_state, batch,
+                jnp.float32(0.01), jnp.int32(0))
+        return self._step_fn.lower(*args).compile()
+
+    def compiled_step_text(self, batch) -> str:
+        """HLO text of the compiled train step (roofline/bench tooling)."""
+        return self.compiled_step(batch).as_text()
+
     def post_step(self) -> None:
         """Periodic host-driven exchange hook (EASGD/GOSGD)."""
 
