@@ -138,3 +138,64 @@ def test_convert_hkl_tree_without_hickle_raises(tmp_path, monkeypatch):
 
     with pytest.raises(ImportError, match="hickle"):
         convert_hkl_tree(str(tmp_path), str(tmp_path / "out"))
+
+
+def test_shm_loader_workers_deterministic_and_conserving(tmp_path):
+    """loader_workers > 0: the shared-memory ring loader must produce a
+    deterministic stream for a fixed seed (regardless of worker timing),
+    conserve the label multiset, and emit identical shapes/dtypes to the
+    inline path (VERDICT r2 #7's multiprocess loader)."""
+    import numpy as np
+
+    from theanompi_tpu.models.data.imagenet import ImageNetData, write_shards
+
+    xs = np.random.RandomState(0).randint(
+        0, 255, (256, 40, 40, 3)).astype(np.uint8)
+    ys = np.random.RandomState(1).randint(0, 10, 256).astype(np.int32)
+    write_shards(str(tmp_path / "train"), xs, ys, 64)
+    write_shards(str(tmp_path / "val"), xs[:64], ys[:64], 64)
+
+    data = ImageNetData({"data_path": str(tmp_path), "image_size": 32,
+                         "loader_workers": 2})
+    run1 = [{k: v.copy() for k, v in b.items()}
+            for b in data.train_batches(64, epoch=0, seed=5)]
+    run2 = [{k: v.copy() for k, v in b.items()}
+            for b in data.train_batches(64, epoch=0, seed=5)]
+    assert len(run1) == 4
+    for a, b in zip(run1, run2):
+        np.testing.assert_array_equal(a["x"], b["x"])
+        np.testing.assert_array_equal(a["y"], b["y"])
+    assert run1[0]["x"].shape == (64, 32, 32, 3)
+    assert run1[0]["x"].dtype == np.uint8
+    got = sorted(np.concatenate([b["y"] for b in run1]).tolist())
+    assert got == sorted(ys.tolist())
+
+    # inline path on the same data still works and yields the same labels
+    inline = ImageNetData({"data_path": str(tmp_path), "image_size": 32})
+    got0 = sorted(
+        np.concatenate([b["y"] for b in inline.train_batches(64, 0, seed=5)]
+                       ).tolist())
+    assert got0 == sorted(ys.tolist())
+    data.cleanup()  # closes the persistent worker ring
+
+
+def test_shm_loader_closes_cleanly_on_early_stop(tmp_path):
+    """Closing the batch generator mid-epoch (what the prefetcher does on
+    early stop) must terminate the worker ring without leaking."""
+    import numpy as np
+
+    from theanompi_tpu.models.data.imagenet import ImageNetData, write_shards
+
+    xs = np.zeros((256, 40, 40, 3), np.uint8)
+    ys = np.zeros(256, np.int32)
+    write_shards(str(tmp_path / "train"), xs, ys, 64)
+    write_shards(str(tmp_path / "val"), xs[:64], ys[:64], 64)
+    data = ImageNetData({"data_path": str(tmp_path), "image_size": 32,
+                         "loader_workers": 2})
+    gen = data.train_batches(64, epoch=0, seed=0)
+    next(gen)
+    gen.close()  # must not hang
+    # the pool survives the early stop and serves the next epoch cleanly
+    n = sum(1 for _ in data.train_batches(64, epoch=1, seed=0))
+    assert n == 4
+    data.cleanup()

@@ -198,3 +198,46 @@ def test_sequential_smoke_cnn():
     # bf16 compute path: cast input, params stay fp32
     y16, _ = net.apply(params, state, x.astype(jnp.bfloat16), train=False)
     assert y16.dtype == jnp.bfloat16
+
+
+def test_layernorm_bf16_tracks_fp32_reference():
+    """ADVICE r2: the bf16 elementwise-normalize path (fp32 stats, input-
+    dtype affine) must stay within bf16 resolution of the full-fp32
+    computation — the bandwidth tradeoff documented in LayerNorm.apply."""
+    from theanompi_tpu.ops.layers import LayerNorm
+
+    ln = LayerNorm()
+    r = np.random.RandomState(0)
+    x32 = jnp.asarray(r.randn(64, 128).astype(np.float32) * 3 + 1.5)
+    params, _, _ = ln.init(jax.random.PRNGKey(0), (128,))
+    params = {"scale": params["scale"] * 1.7, "bias": params["bias"] + 0.3}
+    # isolate the COMPUTATION dtype: both paths see the same bf16-rounded
+    # input (input quantization error would otherwise dominate via
+    # (x - mean) cancellation and say nothing about the arithmetic)
+    x16 = x32.astype(jnp.bfloat16)
+    y32, _ = ln.apply(params, {}, x16.astype(jnp.float32))
+    y16, _ = ln.apply(params, {}, x16)
+    y32a = np.asarray(y32)
+    err = np.abs(np.asarray(y16, np.float32) - y32a)
+    # scale-relative error: near the normalize's zero crossings the
+    # per-element relative error is unbounded for ANY finite precision,
+    # so measure against |y| + the output scale.  A few bf16 ulps
+    # (eps = 2^-8) through the subtract/rsqrt/affine chain is the budget.
+    denom = np.abs(y32a) + y32a.std()
+    assert float((err / denom).max()) < 0.02, float((err / denom).max())
+
+
+def test_batchnorm_bf16_tracks_fp32_reference():
+    from theanompi_tpu.ops.layers import BatchNorm
+
+    bn = BatchNorm()
+    r = np.random.RandomState(1)
+    x32 = jnp.asarray(r.randn(32, 8, 8, 16).astype(np.float32) * 2 - 0.5)
+    params, state, _ = bn.init(jax.random.PRNGKey(0), (8, 8, 16))
+    x16 = x32.astype(jnp.bfloat16)
+    y32, _ = bn.apply(params, state, x16.astype(jnp.float32), train=True)
+    y16, _ = bn.apply(params, state, x16, train=True)
+    y32a = np.asarray(y32)
+    err = np.abs(np.asarray(y16, np.float32) - y32a)
+    denom = np.abs(y32a) + y32a.std()
+    assert float((err / denom).max()) < 0.02, float((err / denom).max())

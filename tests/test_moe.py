@@ -109,3 +109,55 @@ def test_moe_param_specs_shard_experts_only():
     assert moe["up_w"] == P(MODEL_AXIS)
     assert moe["down_b"] == P(MODEL_AXIS)
     assert moe["gate"]["w"] == P()
+
+
+def test_moe_ep4_drop_regime_per_rank_capacity():
+    """Dropping regime under ep>1 (ADVICE r2): capacity is enforced per
+    rank-chunk, so a routing skew concentrated in one chunk drops tokens a
+    single-device (global-pool) run would keep — and tokens kept by BOTH
+    runs produce identical outputs.  This pins the documented semantics
+    instead of leaving the divergence unexercised."""
+    D, E, N = 8, 2, 32
+    layer = MoEFFN(dim=D, n_experts=E, capacity_factor=0.5)
+    params, _, _ = layer.init(jax.random.PRNGKey(0), (N, D))
+    # deterministic routing: feature 0 -> expert 0, feature 1 -> expert 1
+    gate_w = np.zeros((D, E), np.float32)
+    gate_w[0, 0] = gate_w[1, 1] = 10.0
+    params = dict(params)
+    params["gate"] = {"w": jnp.asarray(gate_w)}
+    # tokens 0..15 (= ep-chunks 0 and 1) all want expert 0; 16..31 expert 1
+    x = np.zeros((1, N, D), np.float32)
+    x[0, :16, 0] = 1.0
+    x[0, 16:, 1] = 1.0
+    x += 0.01 * np.random.RandomState(0).randn(1, N, D).astype(np.float32)
+    xj = jnp.asarray(x)
+
+    y1, _ = layer.apply(params, {}, xj)  # single device: cap = ceil(32*.5/2)=8
+
+    mesh = make_mesh(n_data=1, n_model=2)  # ep=2: E=2 experts, 1 per rank
+    pspecs = {"gate": {"w": P()}, "up_w": P(MODEL_AXIS), "up_b": P(MODEL_AXIS),
+              "down_w": P(MODEL_AXIS), "down_b": P(MODEL_AXIS)}
+    f = jax.jit(shard_map(
+        lambda p, x: layer.apply(p, {}, x)[0], mesh,
+        in_specs=(pspecs, P()), out_specs=P(),
+    ))
+    placed = jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        dict(params), pspecs, is_leaf=lambda l: not isinstance(l, dict),
+    )
+    y4 = f(placed, xj)
+
+    kept1 = np.abs(np.asarray(y1)[0]).sum(-1) > 1e-9
+    kept4 = np.abs(np.asarray(y4)[0]).sum(-1) > 1e-9
+    # single device: global pool cap=8 keeps 8 of the 16 expert-0 tokens;
+    # ep=2: chunk 0 (= tokens 0..15, ALL expert 0) has per-rank cap
+    # ceil(16*.5/2)=4 -> keeps only 4, though the global budget had room
+    assert kept1[:16].sum() == 8
+    assert kept4[:16].sum() == 4
+    assert kept4[:4].sum() == 4
+    both = kept1 & kept4
+    assert both.sum() > 0
+    np.testing.assert_allclose(
+        np.asarray(y1)[0][both], np.asarray(y4)[0][both],
+        rtol=1e-5, atol=1e-6,
+    )

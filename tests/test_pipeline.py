@@ -44,7 +44,14 @@ def test_pipeline_params_are_stacked_and_sharded():
     specs = model.param_specs(params)
     from jax.sharding import PartitionSpec as P
 
-    assert all(s == P("pipe") for s in jax.tree.leaves(specs["blocks"]))
+    # every stacked leaf leads with pipe; TP-ruled leaves keep their
+    # Megatron spec behind it (dp x pp x tp composition)
+    for s in jax.tree.leaves(specs["blocks"], is_leaf=lambda x: isinstance(x, P)):
+        assert s[0] == "pipe"
+    assert specs["blocks"]["attn"]["q"]["w"] == P("pipe", None, "model")
+    assert specs["blocks"]["attn"]["o"]["w"] == P("pipe", "model", None)
+    assert specs["blocks"]["up"]["w"] == P("pipe", None, "model")
+    assert specs["blocks"]["ln1"]["scale"] == P("pipe")
     assert all(s == P() for s in jax.tree.leaves(specs["head"]))
 
 
@@ -80,10 +87,39 @@ def test_pp8_trains_and_validates():
     assert len(costs) == 2 and all(np.isfinite(costs)), costs
 
 
-def test_pipeline_rejects_tensor_parallel_mesh():
-    """Uncomposed combination must refuse loudly, not silently double-count
-    (the blocks' TP collectives would run against replicated weights)."""
+def test_pp2_tp2_matches_single_device():
+    """dp1 x pp2 x tp2: the full composition must track the unsharded model
+    through 3 train steps (VERDICT r2 #4).  Steps 2-3 run on updated
+    params, so any mis-composed collective (double-counted TP psum under
+    the pipe schedule, missing pipe-pin on a replicated leaf) diverges."""
+    mesh1 = make_mesh(n_data=1, devices=jax.devices()[:1])
+    t1, c1 = _run_steps(mesh1, dict(CFG))
+
     mesh = make_mesh(n_data=1, n_pipe=2, n_model=2, devices=jax.devices()[:4])
+    t2, c2 = _run_steps(mesh, dict(CFG))
+    np.testing.assert_allclose(c1, c2, rtol=2e-4, atol=2e-5)
+    a = np.asarray(jax.tree.leaves(t1.params["head"])[0])
+    b = np.asarray(jax.tree.leaves(t2.params["head"])[0])
+    np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+    # a TP'd stacked weight is actually distributed over all 4 devices
+    qw = t2.params["blocks"]["attn"]["q"]["w"]
+    assert len(qw.sharding.device_set) == 4
+
+
+def test_dp2_pp2_tp2_trains():
+    """All three axes at once on the 8-device mesh: finite loss, val runs."""
+    mesh = make_mesh(n_data=2, n_pipe=2, n_model=2)
+    model = PipelineTransformerLM({**CFG, "n_epochs": 1})
+    t = BSPTrainer(model, mesh=mesh)
+    rec = t.run()
+    costs = rec.val_history["cost"]
+    assert len(costs) == 1 and all(np.isfinite(costs)), costs
+
+
+def test_pipeline_rejects_seq_parallel_mesh():
+    """The still-uncomposed seq axis must refuse loudly, not silently
+    corrupt gradients."""
+    mesh = make_mesh(n_data=1, n_pipe=2, n_seq=2, devices=jax.devices()[:4])
     model = PipelineTransformerLM({**CFG, "n_layers": 2})
     t = BSPTrainer(model, mesh=mesh)
     with pytest.raises(ValueError, match="does not compose"):

@@ -100,3 +100,32 @@ def test_warmup_resets_gosgd_host_schedule(mesh8):
 def test_default_rulesets_cover_verdict_grid():
     names = [n for n, _, _ in default_rulesets()]
     assert names == ["bsp", "easgd_tau1", "easgd_tau4", "easgd_tau16", "gosgd"]
+
+
+def test_lr_sweep_reports_each_rule_at_its_best(mesh8):
+    """VERDICT r2 #6: with a sweep, each rule's reported row must be its
+    best-performing lr, with the full sweep recorded for audit."""
+    from theanompi_tpu.utils.rulecomp import compare_rules
+
+    art = compare_rules(
+        devices=8,
+        model_config=dict(FAST),
+        target_error=0.9,  # easy target: tiny runs still differentiate lrs
+        max_epochs=2,
+        rules=[("bsp", "BSP", {})],
+        lr_sweep=(0.005, 0.05),
+        verbose=False,
+    )
+    row = art["results"][0]
+    assert art["lr_sweep"] == [0.005, 0.05]
+    assert len(row["lr_sweep"]) == 2
+    assert row["base_lr"] in (0.005, 0.05)
+    swept = {s["base_lr"] for s in row["lr_sweep"]}
+    assert swept == {0.005, 0.05}
+    # the chosen row must be at least as good as every swept row on the
+    # primary criteria (reached, then epochs-to-target)
+    if any(s["reached"] for s in row["lr_sweep"]):
+        assert row["reached"]
+        best_epochs = min(s["epochs_to_target"] for s in row["lr_sweep"]
+                          if s["reached"])
+        assert row["epochs_to_target"] == best_epochs

@@ -55,3 +55,67 @@ def test_none_strategy_skips_exchange(mesh8):
     x = np.arange(8, dtype=np.float32)
     out = np.asarray(f(x))
     np.testing.assert_array_equal(out, x)  # untouched, NOT the mean
+
+
+def test_comm_share_injection_detects_fat_collective(mesh8):
+    """VERDICT r2 #5: a measurement tool that has only ever output 0.0 is
+    unvalidated.  Plant a deliberately fat psum against a tiny compute op
+    and assert the profiler-backed extractor reports a clearly nonzero
+    collective share — and a near-zero one for the same loop without the
+    collective."""
+    import jax
+    import jax.numpy as jnp
+    import tempfile
+    from jax.sharding import PartitionSpec as P
+
+    from theanompi_tpu.parallel.mesh import DATA_AXIS, shard_map
+    from theanompi_tpu.utils.scaling import _trace_comm_split
+
+    big = jnp.ones((512, 2048), jnp.float32)  # 4 MB psum'd every step
+
+    fat = jax.jit(shard_map(
+        lambda x: jax.lax.psum(x, DATA_AXIS) * 0.125, mesh8,
+        in_specs=P(), out_specs=P(),
+    ))
+    lean = jax.jit(shard_map(
+        lambda x: x * 0.125, mesh8, in_specs=P(), out_specs=P(),
+    ))
+
+    def traced_share(fn):
+        fn(big).block_until_ready()
+        d = tempfile.mkdtemp(prefix="inject_")
+        with jax.profiler.trace(d):
+            y = None
+            for _ in range(4):
+                y = fn(big)
+            y.block_until_ready()
+        comm, total = _trace_comm_split(d)
+        assert total > 0, "no device op events captured"
+        return comm / total
+
+    share_fat = traced_share(fat)
+    share_lean = traced_share(lean)
+    assert share_fat > 0.05, f"fat collective invisible: {share_fat}"
+    assert share_lean < share_fat / 2, (share_lean, share_fat)
+
+
+def test_measure_comm_share_on_trainer(mesh8):
+    """The trainer-level wrapper: ring strategy (ppermute chain) on the
+    8-device mesh must show nonzero comm share."""
+    import jax
+
+    from theanompi_tpu.parallel.bsp import BSPTrainer
+    from theanompi_tpu.models.wide_resnet import WideResNet
+    from theanompi_tpu.utils.helper_funcs import shard_batch
+    from theanompi_tpu.utils.scaling import measure_comm_share
+
+    model = WideResNet({**TINY, "batch_size": 4, "bn_axis": "data"})
+    t = BSPTrainer(model, mesh=mesh8, exch_strategy="ring")
+    t.compile_iter_fns()
+    t.init_state()
+    batches = [shard_batch(mesh8, b, spec=t.batch_spec)
+               for b in model.data.train_batches(t.global_batch, 0, seed=0)]
+    jax.block_until_ready(batches)
+    share, comm_s, total_s = measure_comm_share(t, batches, steps=3)
+    assert total_s > 0
+    assert share > 0.0, "trainer comm share measured exactly zero"

@@ -116,13 +116,23 @@ class _ShardSet:
         missing = [p for p in self.y_files if not os.path.exists(p)]
         if missing:
             raise FileNotFoundError(f"label shards missing: {missing[:3]}")
-        self.n = sum(
-            int(np.load(p, mmap_mode="r").shape[0]) for p in self.x_files
-        )
+        # one pass over the headers serves both the count and the worker
+        # ring's slot size (re-scanning thousands of shards would double
+        # dataset construction time)
+        lens = [int(np.load(p, mmap_mode="r").shape[0]) for p in self.x_files]
+        self.n = sum(lens)
+        self.max_len = max(lens)
+
+    def load(self, i: int):
+        return np.load(self.x_files[i]), np.load(self.y_files[i])
+
+    def spec(self, i: int):
+        """Picklable shard handle for pool workers."""
+        return ("files", self.x_files[i], self.y_files[i])
 
     def iter_shards(self, order):
         for i in order:
-            yield np.load(self.x_files[i]), np.load(self.y_files[i])
+            yield self.load(i)
 
 
 class _SyntheticShards:
@@ -151,21 +161,36 @@ class _SyntheticShards:
             self._pattern_cache[cls] = p
         return p
 
-    def iter_shards(self, order):
+    def load(self, i: int):
         s = self.store_size
         reps = s // 8 + 1
+        count = min(self.shard_size, self.n - i * self.shard_size)
+        r = np.random.default_rng(self.seed * 7919 + int(i))
+        y = r.integers(0, self.n_classes, count, dtype=np.int32)
+        # vectorized: stack small patterns, tile to store size, one
+        # fp32 noise draw for the whole shard (the per-image python
+        # loop was the host bottleneck at bench batch sizes)
+        pats = np.stack([self._pattern(int(c)) for c in y])
+        pats = np.tile(pats, (1, reps, reps, 1))[:, :s, :s]
+        noise = r.standard_normal((count, s, s, 3), dtype=np.float32)
+        x = np.clip(pats + noise * 24.0, 0, 255).astype(np.uint8)
+        return x, y
+
+    def spec(self, i: int):
+        """Picklable shard handle for pool workers."""
+        return ("synth", self.n, self.n_classes, self.store_size,
+                self.shard_size, self.seed, int(i))
+
+    def iter_shards(self, order):
         for i in order:
-            count = min(self.shard_size, self.n - i * self.shard_size)
-            r = np.random.default_rng(self.seed * 7919 + int(i))
-            y = r.integers(0, self.n_classes, count, dtype=np.int32)
-            # vectorized: stack small patterns, tile to store size, one
-            # fp32 noise draw for the whole shard (the per-image python
-            # loop was the host bottleneck at bench batch sizes)
-            pats = np.stack([self._pattern(int(c)) for c in y])
-            pats = np.tile(pats, (1, reps, reps, 1))[:, :s, :s]
-            noise = r.standard_normal((count, s, s, 3), dtype=np.float32)
-            x = np.clip(pats + noise * 24.0, 0, 255).astype(np.uint8)
-            yield x, y
+            yield self.load(i)
+
+
+def _load_from_spec(spec):
+    if spec[0] == "files":
+        return np.load(spec[1]), np.load(spec[2])
+    _, n, n_classes, store, shard, seed, i = spec
+    return _SyntheticShards(n, n_classes, store, shard, seed).load(i)
 
 
 class ImageNetData(Dataset):
@@ -187,6 +212,10 @@ class ImageNetData(Dataset):
     def __init__(self, config: dict | None = None):
         config = config or {}
         self.image_size = config.get("image_size", 224)
+        # host-side parallelism: one process cannot feed a v5e chip
+        # (LOADER.json: single-thread load+crop ~1.2k img/s vs ~2.5k
+        # demand), so train shards fan out over a fork pool.  0 = inline.
+        self.loader_workers = int(config.get("loader_workers", 0))
         path = config.get("data_path") or os.environ.get("IMAGENET_PATH")
         if path and os.path.isdir(os.path.join(path, "train")):
             self.synthetic = False
@@ -207,6 +236,7 @@ class ImageNetData(Dataset):
                 self.n_classes = int(max(y.max() for y in ys)) + 1
             self._train_shards = len(self._train.x_files)
             self._val_shards = len(self._val.x_files)
+            self._max_shard = self._train.max_len
         else:
             self.synthetic = True
             self.store_size = config.get("store_size", max(self.image_size + 8, 64))
@@ -222,11 +252,53 @@ class ImageNetData(Dataset):
             )
             self._train_shards = self._train.n_shards
             self._val_shards = self._val.n_shards
+            self._max_shard = shard
         self.n_train = self._train.n
         self.n_val = self._val.n
         self.sample_shape = (self.image_size, self.image_size, 3)
+        self._shm_pool = None
+
+    def _pool(self):
+        """The persistent worker ring, created lazily (spawn costs ~8 s on
+        this image — paid once per dataset, reused every epoch)."""
+        if self._shm_pool is None:
+            from theanompi_tpu.models.data.shm_loader import ShmShardPool
+
+            self._shm_pool = ShmShardPool(self.image_size, self._max_shard,
+                                          self.loader_workers)
+        return self._shm_pool
+
+    def cleanup(self) -> None:
+        if self._shm_pool is not None:
+            self._shm_pool.close()
+            self._shm_pool = None
 
     # -- iteration -----------------------------------------------------------
+    def _augmented_shards(self, src, order, train: bool, rng):
+        """-> iterator of per-shard (x, y), augmented for train.
+
+        ``loader_workers > 0`` (train only) fans shards over a fork pool
+        running :func:`_augment_worker` — load + C crop/mirror + shuffle
+        all happen in the workers, ``imap`` keeps shard order, and the
+        per-shard seeds drawn here make the stream deterministic (a
+        DIFFERENT deterministic stream than the inline path, which draws
+        its augmentation from one sequential rng).
+        """
+        if train and self.loader_workers > 0:
+            seeds = rng.randint(0, 2**31 - 1, size=len(order))
+            tasks = [(src.spec(int(i)), int(s))
+                     for i, s in zip(order, seeds)]
+            yield from self._pool().run(tasks)
+            return
+        for x, y in src.iter_shards(order):
+            if train:
+                x = random_crop_mirror(x, self.image_size, rng)
+                within = rng.permutation(len(x))
+                x, y = x[within], y[within]
+            else:
+                x = center_crop(x, self.image_size)
+            yield x, y
+
     def _batches(self, src, n_shards, batch_size, train: bool, rng=None):
         """Shuffled-shard iteration with a rolling remainder buffer, so exact
         constant-size batches are emitted across shard boundaries (the
@@ -235,13 +307,7 @@ class ImageNetData(Dataset):
         buf_x: list[np.ndarray] = []
         buf_y: list[np.ndarray] = []
         have = 0
-        for x, y in src.iter_shards(order):
-            if train:
-                x = random_crop_mirror(x, self.image_size, rng)
-                within = rng.permutation(len(x))
-                x, y = x[within], y[within]
-            else:
-                x = center_crop(x, self.image_size)
+        for x, y in self._augmented_shards(src, order, train, rng):
             buf_x.append(x)
             buf_y.append(y)
             have += len(x)

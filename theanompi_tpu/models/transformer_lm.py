@@ -288,7 +288,8 @@ class MoETransformerLM(TransformerLM):
 
 
 class PipelineTransformerLM(TransformerLM):
-    """Pipeline-parallel variant: dp × pp (SURVEY.md-beyond, scale contract).
+    """Pipeline-parallel variant: dp × pp × tp (SURVEY.md-beyond, scale
+    contract — the composition a real pod LM run needs).
 
     The ``n_layers`` blocks are *stacked* — every block-param leaf carries a
     leading ``[n_layers, ...]`` axis sharded over the ``pipe`` mesh axis —
@@ -299,8 +300,18 @@ class PipelineTransformerLM(TransformerLM):
     pinned-VJP collectives inside ``pipeline_apply``.  With pipe size 1
     (or no mesh) this is numerically the plain stacked transformer.
 
-    Not yet composed with tensor/sequence parallelism: ``param_specs``
-    shards block leaves over ``pipe`` only.
+    **Tensor parallelism composes structurally**: the stacked block leaves
+    keep their Megatron column/row specs over ``model`` BEHIND the leading
+    ``pipe`` axis (``P(pipe, None, model)`` on a stacked column-parallel
+    weight), so inside ``shard_map`` each device holds its pipe-stage's
+    slice of its tp-shard, and the blocks' f/g collectives psum over
+    ``model`` within every pipe rank exactly as in the unstacked model.
+    The two pinned-VJP families compose because they act on disjoint axes:
+    pipeline's f/g pin ``pipe`` (stage-0 injection / last-stage output),
+    Megatron's f/g pin ``model`` (column inputs / row outputs) — each
+    collective is an identity over the other's axis.  The ``seq`` axis is
+    still refused: ring attention's hop order inside the GPipe scan is
+    untested, and a silent mis-compose would corrupt gradients.
     """
 
     default_config = {
@@ -342,11 +353,19 @@ class PipelineTransformerLM(TransformerLM):
     def param_specs(self, params):
         from theanompi_tpu.parallel.mesh import PIPE_AXIS
 
+        # stacked block leaves shard their leading stage axis over `pipe`;
+        # behind it each leaf keeps its Megatron spec over `model` (rule
+        # paths are matched as "blocks/attn/q/w" etc., same regexes as the
+        # unstacked model)
+        tp = specs_from_rules({"blocks": params["blocks"]}, TP_RULES)["blocks"]
+        stacked = jax.tree.map(
+            lambda spec: P(PIPE_AXIS, *spec),
+            tp, is_leaf=lambda x: isinstance(x, P),
+        )
         return {
             "embed": jax.tree.map(lambda _: P(), params["embed"]),
             "pos": jax.tree.map(lambda _: P(), params["pos"]),
-            # stacked block leaves shard their leading stage axis
-            "blocks": jax.tree.map(lambda _: P(PIPE_AXIS), params["blocks"]),
+            "blocks": stacked,
             "ln_f": jax.tree.map(lambda _: P(), params["ln_f"]),
             "head": jax.tree.map(lambda _: P(), params["head"]),
         }
@@ -359,15 +378,14 @@ class PipelineTransformerLM(TransformerLM):
         from theanompi_tpu.parallel.tensor import axis_bound
 
         cfg = self.config
-        # not yet composed with tensor/sequence parallelism: block specs
-        # replicate over `model`, so the blocks' TP collectives would
-        # double-count silently — refuse instead
-        for ax in ("model", "seq"):
-            if axis_bound(ax) and jax.lax.axis_size(ax) > 1:
-                raise ValueError(
-                    f"PipelineTransformerLM does not compose with a sharded"
-                    f" {ax!r} axis yet; use n_model=1, n_seq=1"
-                )
+        # tensor parallelism composes (stacked Megatron specs + disjoint
+        # pinned-VJP axes — see class docstring); sequence parallelism is
+        # still refused rather than risking silent gradient corruption
+        if axis_bound("seq") and jax.lax.axis_size("seq") > 1:
+            raise ValueError(
+                "PipelineTransformerLM does not compose with a sharded"
+                " 'seq' axis yet; use n_seq=1"
+            )
         emb, _ = self._embed.apply(params["embed"], {}, x)
         emb, _ = self._pos.apply(params["pos"], {}, emb)
 

@@ -44,6 +44,11 @@ def lib():
         return _lib
 
 
+def available() -> bool:
+    """Whether the native kernel is loadable (builds on first call)."""
+    return lib() is not None
+
+
 def _build_and_load():
     if not (os.path.exists(_SO)
             and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):

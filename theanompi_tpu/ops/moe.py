@@ -56,6 +56,21 @@ class MoEFFN(L.Layer):
     load-balance auxiliary loss rides in the layer's *state* under
     ``"aux"`` (replicated across ranks); the model adds it to the training
     loss at its chosen weight.
+
+    **Capacity semantics under EP are per rank-chunk**: each rank routes
+    its ``tokens/ep`` chunk with ``cap = ceil(chunk * cf / E)`` slots per
+    expert, so the global budget per expert is ``ep * cap`` but it is
+    partitioned equally across ranks.  In the dropping regime this
+    deliberately differs from the single-device model (one global
+    ``ceil(tokens * cf / E)`` pool): a chunk whose tokens skew onto one
+    expert drops past its per-rank slice even when the global pool has
+    room.  This is the standard hardware-aligned choice — a shared global
+    pool would need a cross-rank cumsum before dispatch, serializing the
+    all_to_all.  Tokens kept by both variants produce identical outputs;
+    only the drop SETS differ (pinned by
+    ``test_moe_ep4_drop_regime_per_rank_capacity``).  With
+    ``capacity_factor >= n_experts`` nothing can drop and EP is exactly
+    the single-device model.
     """
 
     dim: int

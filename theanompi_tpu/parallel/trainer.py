@@ -242,9 +242,16 @@ class BaseTrainer:
         window excludes XLA compilation: jit compiles at first call, not at
         ``compile_iter_fns`` (which only builds the jit wrappers).
         """
-        batch = next(iter(
-            self.model.data.train_batches(self.global_batch, 0, seed=self.seed)
-        ))
+        gen = self.model.data.train_batches(self.global_batch, 0,
+                                            seed=self.seed)
+        try:
+            batch = next(iter(gen))
+        finally:
+            # run()-loop parity: a prefetch-backed generator left unclosed
+            # here would keep its worker thread/queue alive
+            close = getattr(gen, "close", None)
+            if close:
+                close()
         self.train_iter(batch, lr=self.model.adjust_hyperp(0))
         self.warmup_exchange()
         # one val batch compiles the eval + consensus paths; a full
@@ -252,7 +259,13 @@ class BaseTrainer:
         vb = min(self.global_batch, self.model.data.n_val)
         vb -= vb % self.n_workers  # same divisibility rule as validate()
         if vb:
-            vbatch = next(iter(self.model.data.val_batches(vb)), None)
+            vgen = self.model.data.val_batches(vb)
+            try:
+                vbatch = next(iter(vgen), None)
+            finally:
+                vclose = getattr(vgen, "close", None)
+                if vclose:
+                    vclose()
             if vbatch is not None:
                 self.val_iter(vbatch)
         self.init_state()

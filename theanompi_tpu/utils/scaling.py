@@ -9,10 +9,13 @@ n-device data mesh and reports
 - **weak-scaling efficiency**: images/sec/chip at n relative to n=1 (the
   per-worker batch is fixed, the global batch grows with n — the reference's
   setting);
-- **comm share**: the fraction of step time attributable to the gradient
-  exchange, measured *differentially* (same step compiled with the ``none``
-  strategy) because the collective is fused into the XLA program and
-  invisible to host-side segment timers.
+- **comm share**: the fraction of device op time spent in collectives,
+  measured from the profiler trace (``measure_comm_share`` — per-op device
+  events, collective kinds summed; validated by an injection test that
+  plants a fat collective and asserts a nonzero share).  The old
+  *differential* estimate (same step compiled with the ``none`` strategy)
+  is kept as ``comm_share_differential`` for comparison, but it is
+  noise-dominated on shared/virtual setups and never resolved a signal.
 
 Run on the CPU fake mesh (collectives are memcpys — the harness validates
 the *machinery* and gives an upper bound on framework overhead) or on a real
@@ -26,8 +29,107 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
+import tempfile
 
 import numpy as np
+
+#: collective names across both backends: TPU HLO instruction kinds (via
+#: the roofline op classifier) and CPU thunk/primitive names
+_CPU_COLLECTIVES = ("psum", "pmean", "all_gather", "all_to_all", "ppermute",
+                    "reduce_scatter", "all-reduce", "all-gather",
+                    "all-to-all", "collective-permute", "reduce-scatter")
+_CPU_OP_RE = re.compile(r"^[a-z][\w\-]*(\.\d+)?$")
+
+
+def _trace_comm_split(logdir: str) -> tuple[float, float]:
+    """-> (collective seconds, total op seconds) from the newest xplane.
+
+    TPU: the device plane's per-HLO-op events (same classification as the
+    roofline tool).  CPU (virtual mesh): the ``tf_XLA*`` executor lines
+    carry per-thunk events named after the lowered primitives
+    (``psum.7``, ``dot_general.3``); summing across worker threads
+    weights ops by total worker time, which is the right denominator for
+    a SHARE (the absolute seconds are thread-summed, not wall — see
+    ``comm_op_s_per_step``).  Validated by an injection test that plants
+    a deliberately fat collective and asserts a nonzero share (VERDICT
+    r2 #5 — the old differential method never measured anything but 0).
+    The xplane is parsed exactly once and both backends read the same
+    ``XSpace``.
+    """
+    import glob
+    import os
+
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    from theanompi_tpu.utils.roofline import _op_kind
+
+    paths = sorted(glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                             recursive=True), key=os.path.getmtime)
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {logdir}")
+    xs = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as f:
+        xs.ParseFromString(f.read())
+
+    comm = total = 0.0
+    saw_device = False
+    for plane in xs.planes:
+        if "TPU" not in plane.name and "device" not in plane.name.lower():
+            continue
+        emeta = {k: v.name for k, v in plane.event_metadata.items()}
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            for ev in line.events:
+                saw_device = True
+                kind = _op_kind(emeta.get(ev.metadata_id, ""))
+                if kind == "while":
+                    continue
+                total += ev.duration_ps
+                if kind == "collective":
+                    comm += ev.duration_ps
+    if saw_device:
+        return comm / 1e12, total / 1e12
+
+    # CPU fallback: executor thread lines on the host plane
+    for plane in xs.planes:
+        if "CPU" not in plane.name:
+            continue
+        emeta = {k: v.name for k, v in plane.event_metadata.items()}
+        for line in plane.lines:
+            if not line.name.startswith("tf_XLA"):
+                continue
+            for ev in line.events:
+                nm = emeta.get(ev.metadata_id, "")
+                if not _CPU_OP_RE.match(nm):
+                    continue  # waits, rendezvous, pool bookkeeping, end: markers
+                total += ev.duration_ps
+                base = nm.split(".")[0]
+                if base in _CPU_COLLECTIVES:
+                    comm += ev.duration_ps
+    return comm / 1e12, total / 1e12
+
+
+def measure_comm_share(trainer, batches, steps: int = 6, lr: float = 0.01):
+    """Profiler-backed communication share of the train step.
+
+    -> (comm_share, comm_seconds, total_op_seconds).  Runs ``steps``
+    dispatched steps under ``jax.profiler.trace`` (single end sync, the
+    bench dispatch pattern) and splits device-side op time into
+    collective vs everything else.
+    """
+    import jax
+
+    m = trainer.train_iter(batches[0], lr=lr)  # warm outside the trace
+    float(m["cost"])
+    with tempfile.TemporaryDirectory(prefix="commshare_") as logdir:
+        with jax.profiler.trace(logdir):
+            for i in range(steps):
+                m = trainer.train_iter(batches[i % len(batches)], lr=lr)
+            float(m["cost"])
+        comm_s, total_s = _trace_comm_split(logdir)
+    return (comm_s / total_s if total_s else 0.0), comm_s, total_s
 
 
 def _build(model_name: str, model_config: dict, n: int, strategy: str):
@@ -87,11 +189,17 @@ def measure_scaling(
         times = [r[0] for r in results]
 
         t_noex = dt
+        comm_share = comm_s = 0.0
         if n > 1:
             tr2, b2 = _build(model_name, model_config, n, "none")
             m = tr2.train_iter(b2[0], lr=0.01)
             float(m["cost"])
             (t_noex, _, _), _ = best_trial(tr2, b2, steps, trials)
+            # profiler-backed split (the validated measurement; the
+            # differential column is kept for comparison but is
+            # noise-dominated on shared/virtual setups)
+            comm_share, comm_s, _ = measure_comm_share(trainer, batches,
+                                                       steps=steps)
 
         ips = steps * trainer.global_batch / dt
         per_n[int(n)] = {
@@ -99,7 +207,14 @@ def measure_scaling(
             "step_ms": round(dt / steps * 1e3, 3),
             "imgs_per_sec": round(ips, 2),
             "imgs_per_sec_per_chip": round(ips / n, 2),
-            "comm_share": round(max(0.0, 1.0 - t_noex / dt), 4) if n > 1 else 0.0,
+            "comm_share": round(comm_share, 4),
+            # thread-summed op seconds (NOT wall time — on an n-device
+            # virtual mesh the executor threads' durations add up): only
+            # meaningful relative to the same sum for all ops, which is
+            # exactly what comm_share reports
+            "comm_op_s_per_step": round(comm_s / steps, 6),
+            "comm_share_differential": (
+                round(max(0.0, 1.0 - t_noex / dt), 4) if n > 1 else 0.0),
             "trial_s": [round(t, 4) for t in times],
         }
     for n in ns:
