@@ -166,3 +166,50 @@ def test_fused_lm_xent_no_bias():
     lf = float(fused_lm_xent(h, w, None, y)[0])
     ln = float(_naive_lm_loss(h, w, jnp.zeros((32,)), y))
     np.testing.assert_allclose(lf, ln, rtol=1e-5)
+
+
+def test_fused_lm_xent_vocab_parallel_matches_unsharded():
+    """Megatron parallel CE: the vocab-sharded fused loss (head
+    P(None, model)) must reproduce the unsharded fused loss — value,
+    metrics, and all grads, including the psum-pinned h-cotangent."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from theanompi_tpu.ops.losses import fused_lm_xent, fused_lm_xent_vp
+    from theanompi_tpu.parallel.mesh import MODEL_AXIS, make_mesh, shard_map
+
+    r = np.random.RandomState(0)
+    bsz, t, d, v = 2, 10, 12, 64  # n=20 tokens: pads inside an 8-chunk
+    h = jnp.asarray(r.randn(bsz, t, d).astype(np.float32))
+    w = jnp.asarray(r.randn(d, v).astype(np.float32) * 0.2)
+    b = jnp.asarray(r.randn(v).astype(np.float32) * 0.1)
+    y = jnp.asarray(r.randint(0, v, size=(bsz, t)))
+
+    def ref(h, w, b):
+        loss, e1, e5 = fused_lm_xent(h, w, b, y, chunk_tokens=8)
+        return loss, (e1, e5)
+
+    (lr_, (e1r, e5r)), gr = jax.value_and_grad(ref, argnums=(0, 1, 2),
+                                               has_aux=True)(h, w, b)
+
+    mesh = make_mesh(n_data=1, n_model=4)
+
+    def vp(h, w, b):
+        loss, e1, e5 = fused_lm_xent_vp(h, w, b, y, MODEL_AXIS,
+                                        chunk_tokens=8)
+        return loss, (e1, e5)
+
+    f = jax.jit(shard_map(
+        jax.value_and_grad(vp, argnums=(0, 1, 2), has_aux=True), mesh,
+        in_specs=(P(), P(None, MODEL_AXIS), P(MODEL_AXIS)),
+        out_specs=((P(), (P(), P())), (P(), P(None, MODEL_AXIS), P(MODEL_AXIS))),
+    ))
+    hw = jax.device_put(w, NamedSharding(mesh, P(None, MODEL_AXIS)))
+    hb = jax.device_put(b, NamedSharding(mesh, P(MODEL_AXIS)))
+    (lv, (e1v, e5v)), gv = f(h, hw, hb)
+
+    np.testing.assert_allclose(float(lv), float(lr_), rtol=1e-5)
+    np.testing.assert_allclose(float(e1v), float(e1r), rtol=1e-6)
+    np.testing.assert_allclose(float(e5v), float(e5r), rtol=1e-6)
+    for a, bb, name in zip(gv, gr, ("dh", "dw", "db")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=1e-4, atol=1e-6, err_msg=name)

@@ -294,3 +294,26 @@ def test_fused_loss_auto_enables_at_large_vocab():
     assert int(b["x"].max()) < 8192 and int(b["x"].min()) >= 0
     m = t.train_iter(b, lr=1e-2)
     assert np.isfinite(float(m["cost"]))
+
+
+def test_fused_vocab_parallel_head_tp4_matches_single_device():
+    """fused_loss + tp4: the head shards its vocab over `model` (Megatron
+    parallel CE) and must track the single-device fused run through 3
+    steps; the head weight must actually be distributed."""
+    cfg = {**TINY_LM, "dropout": 0.0, "fused_loss": True}
+    mesh1 = make_mesh(n_data=1, devices=jax.devices()[:1])
+    t1, c1 = _run_steps(mesh1, dict(cfg), steps=3)
+
+    mesh_tp = make_mesh(n_data=1, n_model=4, devices=jax.devices()[:4])
+    t2, c2 = _run_steps(mesh_tp, dict(cfg), steps=3)
+    np.testing.assert_allclose(c1, c2, rtol=1e-4)
+    np.testing.assert_allclose(
+        _replicated_leaf(t1), _replicated_leaf(t2), rtol=1e-4, atol=1e-6
+    )
+    hw = t2.params["head"]["w"]
+    assert len(hw.sharding.device_set) == 4
+    # and the head's post-update values still equal the unsharded run's
+    np.testing.assert_allclose(
+        np.asarray(t1.params["head"]["w"]), np.asarray(hw),
+        rtol=1e-4, atol=1e-6,
+    )

@@ -37,6 +37,7 @@ class Prefetcher:
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._it = it
         self._err: BaseException | None = None
         self._stop = threading.Event()
 
@@ -80,11 +81,14 @@ class Prefetcher:
         return item
 
     def close(self) -> None:
-        """Release the worker and drop queued (device-resident) batches.
+        """Release the worker, drop queued (device-resident) batches, and
+        CLOSE the source generator.
 
         Without this, an abandoned iterator leaves the thread blocked on a
-        full queue with `depth` global batches pinned in HBM for the life of
-        the process.
+        full queue with `depth` global batches pinned in HBM for the life
+        of the process — and a generator-backed source (the shm worker
+        ring holds its epoch lock while suspended at yield) would stay
+        open until GC, blocking the next epoch.
         """
         self._stop.set()
         while True:
@@ -93,6 +97,12 @@ class Prefetcher:
             except queue.Empty:
                 break
         self._thread.join(timeout=5)
+        if not self._thread.is_alive():
+            # closing a generator mid-execution from another thread raises;
+            # only safe once the worker has actually exited
+            close = getattr(self._it, "close", None)
+            if close:
+                close()
 
 
 def prefetch(it, mesh=None, depth: int = 2, spec=None):

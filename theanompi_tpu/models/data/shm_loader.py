@@ -94,6 +94,7 @@ class ShmShardPool:
         for p in self._procs:
             p.start()
         self._closed = False
+        self._broken = False
         self._busy = threading.Lock()
 
     def _get_result(self):
@@ -116,8 +117,8 @@ class ShmShardPool:
                     ) from None
 
     def run(self, tasks):
-        if self._closed:
-            raise RuntimeError("ShmShardPool is closed")
+        if self._closed or self._broken:
+            raise RuntimeError("ShmShardPool is closed or broken")
         if not self._busy.acquire(blocking=False):
             raise RuntimeError(
                 "ShmShardPool already serving an epoch; close the previous"
@@ -164,7 +165,9 @@ class ShmShardPool:
                     for _ in range(inflight):
                         self._get_result()
                 except RuntimeError:
-                    self._closed = True
+                    # a worker died: mark broken (close() still tears the
+                    # survivors + shm down — _closed would no-op it)
+                    self._broken = True
                 pending.clear()
         finally:
             self._busy.release()

@@ -191,8 +191,25 @@ class TransformerLM(SupervisedModel):
         return bool(mode)
 
     # -- sharding ------------------------------------------------------------
+    def _head_specs(self, params):
+        """Head placement: vocab-parallel (Megatron parallel CE) whenever
+        the fused loss is on — w ``P(None, model)``, b ``P(model)`` — so
+        under TP no rank ever sees more than ``[chunk, V/tp]`` scores.  On
+        a size-1 model axis this degrades to replicated, and the plain
+        fused/naive paths read the full head."""
+        from theanompi_tpu.parallel.mesh import MODEL_AXIS
+
+        if not self.fused_loss_enabled():
+            return jax.tree.map(lambda _: P(), params["head"])
+        specs = {"w": P(None, MODEL_AXIS)}
+        if "b" in params["head"]:
+            specs["b"] = P(MODEL_AXIS)
+        return specs
+
     def param_specs(self, params):
-        return specs_from_rules(params, TP_RULES)
+        specs = specs_from_rules(params, TP_RULES)
+        specs["head"] = self._head_specs(params)
+        return specs
 
     def batch_partition(self) -> P:
         if self.config["seq_parallel"]:
@@ -205,7 +222,9 @@ class TransformerLM(SupervisedModel):
         return (DATA_AXIS,)
 
     def loss_fn(self, params, state, batch, rng, train: bool):
-        from theanompi_tpu.ops.losses import fused_lm_xent
+        from theanompi_tpu.ops.losses import fused_lm_xent, fused_lm_xent_vp
+        from theanompi_tpu.parallel.mesh import MODEL_AXIS
+        from theanompi_tpu.parallel.tensor import axis_bound
 
         from theanompi_tpu.ops import softmax_cross_entropy, top_k_error
 
@@ -214,7 +233,12 @@ class TransformerLM(SupervisedModel):
                                         train=train, rng=rng)
         w, b = cp["head"]["w"], cp["head"].get("b")
         if self.fused_loss_enabled():
-            loss, err1, err5 = fused_lm_xent(h, w, b, batch["y"])
+            if axis_bound(MODEL_AXIS) and jax.lax.axis_size(MODEL_AXIS) > 1:
+                # w/b are this shard's vocab slice (see _head_specs)
+                loss, err1, err5 = fused_lm_xent_vp(h, w, b, batch["y"],
+                                                    MODEL_AXIS)
+            else:
+                loss, err1, err5 = fused_lm_xent(h, w, b, batch["y"])
         else:
             logits, _ = self._head.apply(cp["head"], {}, h)
             loss = softmax_cross_entropy(logits, batch["y"])
@@ -367,7 +391,8 @@ class PipelineTransformerLM(TransformerLM):
             "pos": jax.tree.map(lambda _: P(), params["pos"]),
             "blocks": stacked,
             "ln_f": jax.tree.map(lambda _: P(), params["ln_f"]),
-            "head": jax.tree.map(lambda _: P(), params["head"]),
+            # vocab-parallel under tp when the fused loss is on
+            "head": self._head_specs(params),
         }
 
     def apply_trunk(self, params, state, x, *, train, rng):
