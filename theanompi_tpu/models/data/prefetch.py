@@ -97,12 +97,23 @@ class Prefetcher:
             except queue.Empty:
                 break
         self._thread.join(timeout=5)
-        if not self._thread.is_alive():
-            # closing a generator mid-execution from another thread raises;
-            # only safe once the worker has actually exited
-            close = getattr(self._it, "close", None)
-            if close:
-                close()
+        if self._thread.is_alive():
+            # closing a generator mid-execution from another thread raises,
+            # so we cannot free the source here — say so instead of leaving
+            # a silent mystery (a held shm-pool epoch lock surfaces later
+            # as "already serving an epoch")
+            import warnings
+
+            warnings.warn(
+                "Prefetcher.close(): worker still inside the source "
+                "iterator after 5s; source generator left open",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return
+        close = getattr(self._it, "close", None)
+        if close:
+            close()
 
 
 def prefetch(it, mesh=None, depth: int = 2, spec=None):

@@ -54,9 +54,9 @@ def sigmoid_binary_cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.A
 # chunk are zero-padded and masked, so any chunk size serves any N.
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
-def _lm_xent(h3, w, b, y2, mask2, cfg):
-    loss, e1, e5, _ = _lm_xent_scan(h3, w, b, y2, mask2, cfg)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _lm_xent(h3, w, b, y2, mask2, cfg, axis):
+    loss, e1, e5, _ = _lm_xent_scan(h3, w, b, y2, mask2, cfg, axis)
     return loss, e1, e5
 
 
@@ -67,17 +67,43 @@ def _chunk_scores(hc, w, b):
     return s + b.astype(jnp.float32)
 
 
-def _lm_xent_scan(h3, w, b, y2, mask2, cfg):
-    n, v = cfg
+def _chunk_stats(hc, yc, w, b, v, axis):
+    """-> (lse, gold, rank) for one chunk.
 
-    def body(carry, xs):
-        hc, yc, mc = xs
-        s = _chunk_scores(hc, w, b)
+    ``axis=None``: ``w``/``b`` hold the FULL vocab.  ``axis`` set
+    (Megatron parallel CE): they hold this shard's vocab slice and three
+    small collectives assemble the softmax — pmax for the row max, one
+    psum for (normalizer, gold logit), one for the tie-aware rank count.
+    One implementation serves both so the sharded and unsharded training
+    paths cannot diverge.
+    """
+    s = _chunk_scores(hc, w, b)
+    if axis is None:
         m = jnp.max(s, axis=-1)
         lse = m + jnp.log(jnp.sum(jnp.exp(s - m[:, None]), axis=-1))
         gold = jnp.take_along_axis(s, yc[:, None], axis=-1)[:, 0]
         # >= rank: ties score against the model (same rule as top_k_error)
         rank = jnp.sum(s >= gold[:, None], axis=-1) - 1
+        return lse, gold, rank
+    m = lax.pmax(jnp.max(s, axis=-1), axis)
+    e = jnp.exp(s - m[:, None])
+    y_loc = yc - lax.axis_index(axis) * v
+    in_range = (y_loc >= 0) & (y_loc < v)
+    idx = jnp.clip(y_loc, 0, v - 1)
+    gold_loc = jnp.take_along_axis(s, idx[:, None], axis=-1)[:, 0]
+    gold_loc = jnp.where(in_range, gold_loc, 0.0)
+    l, gold = lax.psum(jnp.stack([jnp.sum(e, axis=-1), gold_loc]), axis)
+    lse = m + jnp.log(l)
+    rank = lax.psum(jnp.sum(s >= gold[:, None], axis=-1), axis) - 1
+    return lse, gold, rank
+
+
+def _lm_xent_scan(h3, w, b, y2, mask2, cfg, axis):
+    n, v = cfg
+
+    def body(carry, xs):
+        hc, yc, mc = xs
+        lse, gold, rank = _chunk_stats(hc, yc, w, b, v, axis)
         mf = mc.astype(jnp.float32)
         ls, c1, c5 = carry
         return (
@@ -91,22 +117,24 @@ def _lm_xent_scan(h3, w, b, y2, mask2, cfg):
     return ls / n, c1 / n, c5 / n, lse2
 
 
-def _lm_xent_fwd(h3, w, b, y2, mask2, cfg):
-    loss, e1, e5, lse2 = _lm_xent_scan(h3, w, b, y2, mask2, cfg)
+def _lm_xent_fwd(h3, w, b, y2, mask2, cfg, axis):
+    loss, e1, e5, lse2 = _lm_xent_scan(h3, w, b, y2, mask2, cfg, axis)
     return (loss, e1, e5), (h3, w, b, y2, mask2, lse2)
 
 
-def _lm_xent_bwd(cfg, res, cts):
+def _lm_xent_bwd(cfg, axis, res, cts):
     h3, w, b, y2, mask2, lse2 = res
     n, v = cfg
     g = cts[0] / n  # error cotangents drop: step functions, zero-grad a.e.
     ids = jnp.arange(v, dtype=y2.dtype)
+    # vocab-sharded: labels offset to local ids (out-of-range matches none)
+    lo = 0 if axis is None else lax.axis_index(axis) * v
 
     def body(carry, xs):
         hc, yc, mc, lsec = xs
         s = _chunk_scores(hc, w, b)
         p = jnp.exp(s - lsec[:, None])
-        dl = (p - (yc[:, None] == ids[None, :])) * (g * mc[:, None])
+        dl = (p - ((yc - lo)[:, None] == ids[None, :])) * (g * mc[:, None])
         dlc = dl.astype(hc.dtype)  # bf16 for the MXU, like the naive path
         dh = lax.dot_general(dlc, w.astype(dlc.dtype),
                              (((1,), (1,)), ((), ())),
@@ -121,6 +149,10 @@ def _lm_xent_bwd(cfg, res, cts):
     dw0 = jnp.zeros(w.shape, jnp.float32)
     db0 = jnp.zeros(b.shape, jnp.float32)
     (dw, db), dh3 = lax.scan(body, (dw0, db0), (h3, y2, mask2, lse2))
+    if axis is not None:
+        # h is replicated over the vocab axis; each shard's dh is the
+        # partial from its slice (the Megatron-f pin, explicit here)
+        dh3 = lax.psum(dh3, axis)
     f0 = jax.dtypes.float0
     return (dh3.astype(h3.dtype), dw.astype(w.dtype), db.astype(b.dtype),
             np.zeros(y2.shape, f0), np.zeros(mask2.shape, f0))
@@ -171,105 +203,7 @@ def fused_lm_xent(h: jax.Array, w: jax.Array, b: jax.Array | None,
     h3, y2, mask2, n = _chunk_and_pad(h, labels, v, chunk_tokens)
     if b is None:
         b = jnp.zeros((v,), jnp.float32)
-    return _lm_xent(h3, w, b, y2, mask2, (n, v))
-
-
-# -- vocab-parallel variant (Megatron parallel cross entropy) ----------------
-#
-# Under tensor parallelism the head can shard its VOCAB dim over `model`
-# (w: P(None, model)), so no rank ever holds full-vocab logits even
-# transiently: each computes its [C, V/tp] score slice and three small
-# collectives per chunk assemble the softmax pieces — pmax for the row max,
-# one psum for (normalizer, gold logit), one for the tie-aware rank count.
-# The backward's h-cotangent is the sum of per-shard partials (each shard
-# only sees its vocab slice), pinned with an explicit psum exactly like the
-# Megatron f operator.
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
-def _lm_xent_vp(h3, w, b, y2, mask2, cfg, axis):
-    loss, e1, e5, _ = _lm_xent_vp_scan(h3, w, b, y2, mask2, cfg, axis)
-    return loss, e1, e5
-
-
-def _vp_chunk_stats(hc, yc, w, b, v_local, axis):
-    """-> (s, lse, gold, rank) for one chunk with vocab sharded on axis."""
-    s = _chunk_scores(hc, w, b)  # [C, v_local] fp32
-    m = lax.pmax(jnp.max(s, axis=-1), axis)
-    e = jnp.exp(s - m[:, None])
-    lo = lax.axis_index(axis) * v_local
-    y_loc = yc - lo
-    in_range = (y_loc >= 0) & (y_loc < v_local)
-    idx = jnp.clip(y_loc, 0, v_local - 1)
-    gold_loc = jnp.take_along_axis(s, idx[:, None], axis=-1)[:, 0]
-    gold_loc = jnp.where(in_range, gold_loc, 0.0)
-    l_loc = jnp.sum(e, axis=-1)
-    l, gold = lax.psum(jnp.stack([l_loc, gold_loc]), axis)
-    lse = m + jnp.log(l)
-    rank = lax.psum(jnp.sum(s >= gold[:, None], axis=-1), axis) - 1
-    return s, lse, gold, rank
-
-
-def _lm_xent_vp_scan(h3, w, b, y2, mask2, cfg, axis):
-    n, v_local = cfg
-
-    def body(carry, xs):
-        hc, yc, mc = xs
-        _, lse, gold, rank = _vp_chunk_stats(hc, yc, w, b, v_local, axis)
-        mf = mc.astype(jnp.float32)
-        ls, c1, c5 = carry
-        return (
-            ls + jnp.sum((lse - gold) * mf),
-            c1 + jnp.sum((rank >= 1).astype(jnp.float32) * mf),
-            c5 + jnp.sum((rank >= 5).astype(jnp.float32) * mf),
-        ), lse
-
-    zero = jnp.zeros((), jnp.float32)
-    (ls, c1, c5), lse2 = lax.scan(body, (zero, zero, zero), (h3, y2, mask2))
-    return ls / n, c1 / n, c5 / n, lse2
-
-
-def _lm_xent_vp_fwd(h3, w, b, y2, mask2, cfg, axis):
-    loss, e1, e5, lse2 = _lm_xent_vp_scan(h3, w, b, y2, mask2, cfg, axis)
-    return (loss, e1, e5), (h3, w, b, y2, mask2, lse2)
-
-
-def _lm_xent_vp_bwd(cfg, axis, res, cts):
-    h3, w, b, y2, mask2, lse2 = res
-    n, v_local = cfg
-    g = cts[0] / n
-    lo = lax.axis_index(axis) * v_local
-    ids = jnp.arange(v_local, dtype=y2.dtype)
-
-    def body(carry, xs):
-        hc, yc, mc, lsec = xs
-        s = _chunk_scores(hc, w, b)
-        p = jnp.exp(s - lsec[:, None])
-        y_loc = yc - lo  # out-of-range labels match no local id
-        dl = (p - (y_loc[:, None] == ids[None, :])) * (g * mc[:, None])
-        dlc = dl.astype(hc.dtype)
-        dh = lax.dot_general(dlc, w.astype(dlc.dtype),
-                             (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-        dw_acc, db_acc = carry
-        dw_acc = dw_acc + lax.dot_general(
-            hc, dlc, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        db_acc = db_acc + jnp.sum(dl, axis=0)
-        return (dw_acc, db_acc), dh
-
-    dw0 = jnp.zeros(w.shape, jnp.float32)
-    db0 = jnp.zeros(b.shape, jnp.float32)
-    (dw, db), dh3 = lax.scan(body, (dw0, db0), (h3, y2, mask2, lse2))
-    # h is replicated over the vocab axis; each shard's dh is the partial
-    # from its vocab slice (the Megatron-f pin, explicit here)
-    dh3 = lax.psum(dh3, axis)
-    f0 = jax.dtypes.float0
-    return (dh3.astype(h3.dtype), dw.astype(w.dtype), db.astype(b.dtype),
-            np.zeros(y2.shape, f0), np.zeros(mask2.shape, f0))
-
-
-_lm_xent_vp.defvjp(_lm_xent_vp_fwd, _lm_xent_vp_bwd)
+    return _lm_xent(h3, w, b, y2, mask2, (n, v), None)
 
 
 def fused_lm_xent_vp(h: jax.Array, w_local: jax.Array,
@@ -277,18 +211,19 @@ def fused_lm_xent_vp(h: jax.Array, w_local: jax.Array,
                      axis_name: str, chunk_tokens: int | None = None):
     """Vocab-parallel fused LM loss -> ``(loss, top1_err, top5_err)``.
 
-    ``w_local``/``b_local`` are this shard's vocab slice (``P(None,
-    model)`` / ``P(model)``); ``h`` and ``labels`` are replicated over
-    ``axis_name``.  Semantics match :func:`fused_lm_xent` on the gathered
-    head exactly (same masking/padding and tie-rank rules); no rank ever
+    Megatron parallel cross entropy: ``w_local``/``b_local`` are this
+    shard's vocab slice (``P(None, model)`` / ``P(model)``); ``h`` and
+    ``labels`` are replicated over ``axis_name``.  Semantics match
+    :func:`fused_lm_xent` on the gathered head exactly (same chunking,
+    masking, and tie-rank rules — it IS the same implementation with the
+    per-chunk softmax assembled by collectives); no rank ever
     materializes more than ``[chunk, V/tp]`` scores.
     """
     v_local = w_local.shape[-1]
     h3, y2, mask2, n = _chunk_and_pad(h, labels, v_local, chunk_tokens)
     if b_local is None:
         b_local = jnp.zeros((v_local,), jnp.float32)
-    return _lm_xent_vp(h3, w_local, b_local, y2, mask2,
-                       (n, v_local), axis_name)
+    return _lm_xent(h3, w_local, b_local, y2, mask2, (n, v_local), axis_name)
 
 
 def top_k_error(logits: jax.Array, labels: jax.Array, k: int = 1) -> jax.Array:

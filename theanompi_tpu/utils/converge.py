@@ -103,8 +103,11 @@ def converge_dcgan(devices=8, n_epochs=30, verbose=True) -> dict:
            "precision": "fp32", "verbose": False}
     model = DCGAN(cfg)
     mesh = make_mesh(n_data=devices)
+    # print_freq=8: train_history only fills at print boundaries (the
+    # recorder never records per-iteration to avoid device syncs), so a
+    # huge print_freq would leave the loss curves EMPTY
     trainer = BSPTrainer(model, mesh=mesh,
-                         recorder=Recorder(verbose=False, print_freq=10**9))
+                         recorder=Recorder(verbose=False, print_freq=8))
     rec = trainer.run()
 
     params = trainer.params
