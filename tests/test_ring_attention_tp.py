@@ -317,3 +317,19 @@ def test_fused_vocab_parallel_head_tp4_matches_single_device():
         np.asarray(t1.params["head"]["w"]), np.asarray(hw),
         rtol=1e-4, atol=1e-6,
     )
+
+
+def test_fused_loss_seq_parallel_matches_single_device():
+    """fused_loss + sp4: sequence-sharded tokens feed the chunked loss as
+    local means with the gradient mean-reduced over `seq` — must track the
+    single-device fused run through 3 steps."""
+    cfg = {**TINY_LM, "dropout": 0.0, "fused_loss": True}
+    mesh1 = make_mesh(n_data=1, devices=jax.devices()[:1])
+    t1, c1 = _run_steps(mesh1, {**cfg, "seq_parallel": False}, steps=3)
+
+    mesh_sp = make_mesh(n_data=1, n_seq=4, devices=jax.devices()[:4])
+    t2, c2 = _run_steps(mesh_sp, {**cfg, "seq_parallel": True}, steps=3)
+    np.testing.assert_allclose(c1, c2, rtol=1e-4)
+    np.testing.assert_allclose(
+        _replicated_leaf(t1), _replicated_leaf(t2), rtol=1e-4, atol=1e-6
+    )
