@@ -85,9 +85,23 @@ class MultiHeadAttention(L.Layer):
         b, t, _ = x.shape
         head_dim = self.dim // self.heads
         x = identity_fwd_psum_bwd(x)  # once for all three projections
-        q, _ = subs["q"].apply(params["q"], {}, x)
-        k, _ = subs["k"].apply(params["k"], {}, x)
-        v, _ = subs["v"].apply(params["v"], {}, x)
+        # one fused QKV matmul: the params stay three separate leaves (TP
+        # rules, checkpoints, tests address them unchanged) but the weights
+        # concatenate at apply time so x is read once, not three times —
+        # under TP each leaf is the local [D, D/tp] slice and the concat is
+        # the local slice of the fused projection (Megatron's layout)
+        w_qkv = jnp.concatenate(
+            [params["q"]["w"], params["k"]["w"], params["v"]["w"]], axis=1
+        ).astype(x.dtype)
+        qkv = x @ w_qkv
+        if "b" in params["q"]:
+            qkv = qkv + jnp.concatenate(
+                [params["q"]["b"], params["k"]["b"], params["v"]["b"]]
+            ).astype(x.dtype)
+        d_local = params["q"]["w"].shape[1]
+        q = qkv[..., :d_local]
+        k = qkv[..., d_local:2 * d_local]
+        v = qkv[..., 2 * d_local:]
         # local head count falls out of the (possibly sharded) width
         h_local = q.shape[-1] // head_dim
         q = q.reshape(b, t, h_local, head_dim)
