@@ -101,9 +101,10 @@ def test_pp2_tp2_matches_single_device():
     a = np.asarray(jax.tree.leaves(t1.params["head"])[0])
     b = np.asarray(jax.tree.leaves(t2.params["head"])[0])
     np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
-    # a TP'd stacked weight is actually distributed over all 4 devices
+    # a TP'd stacked weight is actually SHARDED (device_set size alone is
+    # vacuous: replicated arrays also span all devices)
     qw = t2.params["blocks"]["attn"]["q"]["w"]
-    assert len(qw.sharding.device_set) == 4
+    assert not qw.sharding.is_fully_replicated
 
 
 def test_dp2_pp2_tp2_trains():
@@ -143,3 +144,22 @@ def test_pipeline_rejects_indivisible_microbatch():
         jax.jit(shard_map(f, mesh, in_specs=P(), out_specs=P()))(
             jnp.ones((8, 4))
         )
+
+
+def test_pp2_tp2_with_fused_vocab_parallel_loss():
+    """The full stack at once: GPipe over `pipe`, Megatron splits + the
+    vocab-parallel fused loss over `model` — must track the single-device
+    fused run through 3 steps, with the head actually vocab-sharded."""
+    cfg = {**CFG, "fused_loss": True}
+    mesh1 = make_mesh(n_data=1, devices=jax.devices()[:1])
+    t1, c1 = _run_steps(mesh1, dict(cfg))
+
+    mesh = make_mesh(n_data=1, n_pipe=2, n_model=2, devices=jax.devices()[:4])
+    t2, c2 = _run_steps(mesh, dict(cfg))
+    np.testing.assert_allclose(c1, c2, rtol=2e-4, atol=2e-5)
+    hw = t2.params["head"]["w"]
+    assert not hw.sharding.is_fully_replicated  # vocab actually sharded
+    np.testing.assert_allclose(
+        np.asarray(t1.params["head"]["w"]), np.asarray(hw),
+        rtol=2e-4, atol=2e-5,
+    )

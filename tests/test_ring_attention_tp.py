@@ -222,9 +222,9 @@ def test_transformer_tp_matches_single_device():
     np.testing.assert_allclose(
         _replicated_leaf(t1), _replicated_leaf(t2), rtol=1e-4, atol=1e-6
     )
-    # a TP'd weight is actually distributed over 4 devices
+    # a TP'd weight is actually SHARDED (device_set size alone is vacuous)
     qw = t2.params["02__block"]["attn"]["q"]["w"]
-    assert len(qw.sharding.device_set) == 4
+    assert not qw.sharding.is_fully_replicated
 
 
 def test_transformer_sp_matches_single_device():
@@ -311,7 +311,7 @@ def test_fused_vocab_parallel_head_tp4_matches_single_device():
         _replicated_leaf(t1), _replicated_leaf(t2), rtol=1e-4, atol=1e-6
     )
     hw = t2.params["head"]["w"]
-    assert len(hw.sharding.device_set) == 4
+    assert not hw.sharding.is_fully_replicated  # vocab actually sharded
     # and the head's post-update values still equal the unsharded run's
     np.testing.assert_allclose(
         np.asarray(t1.params["head"]["w"]), np.asarray(hw),

@@ -5,9 +5,10 @@ The reference's validation discipline was convergence-as-test (SURVEY.md
 step of the ImageNet-class models in CI.  This harness trains, in bounded
 minutes on the virtual mesh:
 
-- **ResNet-50** (small-image head: 64 px, 10-class synthetic shards) and
-  **AlexNet with grouped convs** to a fixed validation-error target under
-  the BSP rule, reusing the rulecomp train-to-target machinery;
+- **ResNet-50** (small-image head: 64 px, 10-class synthetic shards),
+  **AlexNet with grouped convs**, and **VGG-11 (+BN)** to fixed
+  validation-error targets under the BSP rule, reusing the rulecomp
+  train-to-target machinery;
 - **DCGAN** for a few epochs, then records a sample-quality proxy:
   per-pixel std across generated samples (mode-collapse detector — a
   collapsed generator emits near-identical images) and the discriminator's
@@ -50,7 +51,29 @@ CLASSIFIER_RUNS = [
          "lr_decay_epochs": (), "weight_decay": 0.0, "precision": "fp32"},
         0.35, 25,
     ),
+    (
+        "vgg11",
+        "theanompi_tpu.models.vggnet_16", "VGGNet_11_Shallow",
+        {"image_size": 64, "store_size": 72, "n_classes": 10,
+         "batch_size": 16, "n_train": 512, "n_val": 128, "shard_size": 128,
+         "dropout": 0.25, "lr": 0.002, "bn": True,
+         "lr_decay_epochs": (), "weight_decay": 0.0, "precision": "fp32"},
+        0.35, 20,
+    ),
 ]
+
+#: models deliberately NOT in the bounded harness, with why (emitted into
+#: the artifact so regeneration preserves the record)
+EXCLUDED = {
+    "googlenet_aux": (
+        "learns but converges too slowly for the bounded-minutes gate at "
+        "the 512-image/64px no-BN scale: probed best val error 0.64 after "
+        "20 epochs at lr 2e-3 and 0.77 after 12 at lr 1e-3/5e-3; "
+        "correctness is covered by the aux-head gradient-flow tests "
+        "(tests/test_zoo.py), full convergence needs the real-data scale "
+        "the reference used"
+    ),
+}
 
 
 def converge_classifiers(devices=8, runs=None, verbose=True) -> list[dict]:
@@ -162,7 +185,8 @@ def main(argv=None):
     rows.append(converge_dcgan(devices=args.devices,
                                n_epochs=args.dcgan_epochs))
     art = {"devices": args.devices, "results": rows,
-           "passed": all(r["passed"] for r in rows)}
+           "passed": all(r["passed"] for r in rows),
+           "excluded": EXCLUDED}
     with open(args.out, "w") as f:
         json.dump(art, f, indent=1)
     print(json.dumps({"passed": art["passed"], "out": args.out}))
