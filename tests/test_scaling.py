@@ -29,7 +29,10 @@ def test_scaling_harness_artifact(tmp_path):
         r = art["per_n"][n]
         assert r["global_batch"] == 8 * n
         assert r["imgs_per_sec"] > 0
-        assert 0.0 <= r["comm_share"] <= 1.0
+        # None on a JAX-only install (no xplane protos — ADVICE r3 #1);
+        # a numeric share otherwise
+        if r["comm_share"] is not None:
+            assert 0.0 <= r["comm_share"] <= 1.0
         assert r["efficiency"] > 0
     assert art["per_n"][1]["efficiency"] == 1.0
     # artifact round-trips (per_n keys become strings in json)

@@ -155,7 +155,15 @@ class TransformerLM(SupervisedModel):
         """The TRUNK only (embed … final LN).  The LM head lives outside the
         Sequential so the loss can fuse the head matmul into a chunked
         cross entropy (``ops.losses.fused_lm_xent``) instead of
-        materializing ``[B, T, V]`` fp32 logits — ruinous at real vocab."""
+        materializing ``[B, T, V]`` fp32 logits — ruinous at real vocab.
+
+        **Checkpoint format break** (documented per ADVICE r3 #3): the head
+        moved from the Sequential's trailing Dense (leaf
+        ``NN_dense/{w,b}``) to a top-level ``head`` key, so transformer
+        checkpoints written before this change no longer restore.  No shim
+        is kept — prior-round checkpoints were test artifacts, and the
+        restore fails loudly (``KeyError: 'head/w'``) rather than silently
+        mismapping."""
         cfg = self.config
         layers: list[L.Layer] = [
             L.Embedding(self.data.vocab, cfg["dim"],

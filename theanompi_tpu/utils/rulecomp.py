@@ -104,7 +104,9 @@ def _better(a: dict, b: dict) -> bool:
     if a["reached"]:
         return (a["epochs_to_target"], a["wall_s"]) < (
             b["epochs_to_target"], b["wall_s"])
-    return (a["best_val_error"] or 1e9) < (b["best_val_error"] or 1e9)
+    a_err = 1e9 if a["best_val_error"] is None else a["best_val_error"]
+    b_err = 1e9 if b["best_val_error"] is None else b["best_val_error"]
+    return a_err < b_err
 
 
 def compare_rules(devices=8, model_config: dict | None = None,

@@ -111,6 +111,18 @@ def _trace_comm_split(logdir: str) -> tuple[float, float]:
     return comm / 1e12, total / 1e12
 
 
+def _have_xplane_protos() -> bool:
+    """Whether tensorflow's xplane protos (the trace parser's only
+    third-party need) are importable — probed before any profiled run."""
+    import importlib.util
+
+    try:
+        return importlib.util.find_spec(
+            "tensorflow.tsl.profiler.protobuf.xplane_pb2") is not None
+    except (ImportError, ModuleNotFoundError):
+        return False
+
+
 def measure_comm_share(trainer, batches, steps: int = 6, lr: float = 0.01):
     """Profiler-backed communication share of the train step.
 
@@ -197,9 +209,18 @@ def measure_scaling(
             (t_noex, _, _), _ = best_trial(tr2, b2, steps, trials)
             # profiler-backed split (the validated measurement; the
             # differential column is kept for comparison but is
-            # noise-dominated on shared/virtual setups)
-            comm_share, comm_s, _ = measure_comm_share(trainer, batches,
-                                                       steps=steps)
+            # noise-dominated on shared/virtual setups).  The xplane
+            # parser needs tensorflow's profiler protos — on a JAX-only
+            # install record comm_share as null instead of crashing
+            # (ADVICE r3 #1); the differential column below remains the
+            # only estimate in that case.  Availability is probed once
+            # up front (``_have_xplane_protos``) so no profiled run is
+            # wasted and unrelated ImportErrors still surface.
+            if _have_xplane_protos():
+                comm_share, comm_s, _ = measure_comm_share(
+                    trainer, batches, steps=steps)
+            else:
+                comm_share = comm_s = None
 
         ips = steps * trainer.global_batch / dt
         per_n[int(n)] = {
@@ -207,12 +228,14 @@ def measure_scaling(
             "step_ms": round(dt / steps * 1e3, 3),
             "imgs_per_sec": round(ips, 2),
             "imgs_per_sec_per_chip": round(ips / n, 2),
-            "comm_share": round(comm_share, 4),
+            "comm_share": (None if comm_share is None
+                           else round(comm_share, 4)),
             # thread-summed op seconds (NOT wall time — on an n-device
             # virtual mesh the executor threads' durations add up): only
             # meaningful relative to the same sum for all ops, which is
             # exactly what comm_share reports
-            "comm_op_s_per_step": round(comm_s / steps, 6),
+            "comm_op_s_per_step": (None if comm_s is None
+                                   else round(comm_s / steps, 6)),
             "comm_share_differential": (
                 round(max(0.0, 1.0 - t_noex / dt), 4) if n > 1 else 0.0),
             "trial_s": [round(t, 4) for t in times],
@@ -275,9 +298,11 @@ def main(argv=None):
                           out_path=args.out)
     for n in art["ns"]:
         r = art["per_n"][n]
+        comm = ("  n/a" if r["comm_share"] is None
+                else f"{r['comm_share']:5.3f}")
         print(f"n={n}: {r['imgs_per_sec']:9.1f} img/s "
               f"({r['imgs_per_sec_per_chip']:8.1f}/chip)  "
-              f"eff {r['efficiency']:5.3f}  comm {r['comm_share']:5.3f}")
+              f"eff {r['efficiency']:5.3f}  comm {comm}")
     print(f"wrote {args.out}")
 
 
