@@ -1,0 +1,91 @@
+"""Gradient accumulation (``n_subb`` — reference contract SURVEY.md §2.3:
+file-batches trained in sub-batches with cumulative gradients).
+
+The core claim is exactness: with per-example normalization the
+micro-batched scan's mean gradient IS the full-batch gradient, so training
+with ``n_subb`` must reproduce full-batch training step for step.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from theanompi_tpu.models.transformer_lm import TransformerLM
+from theanompi_tpu.models.wide_resnet import WideResNet
+from theanompi_tpu.parallel.bsp import BSPTrainer
+from theanompi_tpu.parallel.mesh import make_mesh
+
+TLM_CFG = {
+    "batch_size": 8, "n_train": 64, "n_val": 32, "seq_len": 64,
+    "vocab": 64, "dim": 64, "heads": 2, "n_layers": 2, "dropout": 0.0,
+    "n_epochs": 1, "precision": "fp32", "attn_impl": "blockwise",
+}
+
+
+def _trained_params(cfg, steps=3):
+    model = TransformerLM(cfg)
+    t = BSPTrainer(model, mesh=make_mesh(n_data=1,
+                                         devices=jax.devices()[:1]))
+    t.compile_iter_fns()
+    t.init_state()
+    batches = list(model.data.train_batches(t.global_batch, 0, seed=0))
+    m = None
+    for i in range(steps):
+        m = t.train_iter(batches[i % len(batches)], lr=1e-2)
+    return t.params, m
+
+
+def test_accumulated_equals_full_batch():
+    """n_subb=4 ≡ n_subb=1 on an LN-only model (exact up to fp assoc)."""
+    p_full, m_full = _trained_params(dict(TLM_CFG))
+    p_acc, m_acc = _trained_params({**TLM_CFG, "n_subb": 4})
+    np.testing.assert_allclose(float(m_acc["cost"]), float(m_full["cost"]),
+                               rtol=1e-5)
+    flat_f = jax.tree_util.tree_leaves_with_path(p_full)
+    flat_a = {tuple(str(k) for k in path): leaf
+              for path, leaf in jax.tree_util.tree_leaves_with_path(p_acc)}
+    for path, leaf in flat_f:
+        key = tuple(str(k) for k in path)
+        np.testing.assert_allclose(
+            np.asarray(flat_a[key]), np.asarray(leaf),
+            rtol=2e-5, atol=2e-6, err_msg=f"param {key} diverged",
+        )
+
+
+def test_accum_with_bn_trains(mesh8):
+    """BN model: micro-batch statistics (documented semantics) — the step
+    must run under the data-parallel exchange and stay finite."""
+    model = WideResNet({
+        "depth": 10, "widen": 1, "batch_size": 4, "n_train": 64,
+        "n_val": 16, "n_epochs": 1, "precision": "fp32", "n_subb": 2,
+    })
+    t = BSPTrainer(model, mesh=mesh8)
+    t.compile_iter_fns()
+    t.init_state()
+    batch = next(iter(model.data.train_batches(t.global_batch, 0, seed=0)))
+    m1 = t.train_iter(batch, lr=0.05)
+    m2 = t.train_iter(batch, lr=0.05)
+    assert np.isfinite(float(m1["cost"])) and np.isfinite(float(m2["cost"]))
+
+
+def test_indivisible_batch_raises():
+    model = TransformerLM({**TLM_CFG, "n_subb": 3})  # 8 % 3 != 0
+    t = BSPTrainer(model, mesh=make_mesh(n_data=1,
+                                         devices=jax.devices()[:1]))
+    t.compile_iter_fns()
+    t.init_state()
+    batch = next(iter(model.data.train_batches(t.global_batch, 0, seed=0)))
+    with pytest.raises(ValueError, match="n_subb"):
+        t.train_iter(batch, lr=1e-2)
+
+
+def test_custom_step_refuses_n_subb():
+    from theanompi_tpu.models.dcgan import DCGAN
+
+    model = DCGAN({"batch_size": 4, "n_train": 16, "n_val": 8,
+                   "n_epochs": 1, "n_subb": 2})
+    t = BSPTrainer(model, mesh=make_mesh(n_data=1,
+                                         devices=jax.devices()[:1]))
+    with pytest.raises(ValueError, match="n_subb"):
+        t.compile_iter_fns()

@@ -9,8 +9,15 @@ judge/driver can read; the *numbers* only mean something on real chips.
 import json
 
 import numpy as np
+import pytest
 
-from theanompi_tpu.utils.scaling import measure_scaling
+from theanompi_tpu.utils.scaling import _have_xplane_protos, measure_scaling
+
+# the profiler-backed comm-share tests parse xplanes via tensorflow's
+# protos; on a JAX-only install they skip (the harness itself records
+# comm_share as null there — covered by test_scaling_harness_artifact)
+needs_xplane = pytest.mark.skipif(
+    not _have_xplane_protos(), reason="tensorflow xplane protos unavailable")
 
 TINY = {
     "depth": 10, "widen": 1, "batch_size": 8, "n_train": 64, "n_val": 16,
@@ -60,6 +67,7 @@ def test_none_strategy_skips_exchange(mesh8):
     np.testing.assert_array_equal(out, x)  # untouched, NOT the mean
 
 
+@needs_xplane
 def test_comm_share_injection_detects_fat_collective(mesh8):
     """VERDICT r2 #5: a measurement tool that has only ever output 0.0 is
     unvalidated.  Plant a deliberately fat psum against a tiny compute op
@@ -102,6 +110,7 @@ def test_comm_share_injection_detects_fat_collective(mesh8):
     assert share_lean < share_fat / 2, (share_lean, share_fat)
 
 
+@needs_xplane
 def test_measure_comm_share_on_trainer(mesh8):
     """The trainer-level wrapper: ring strategy (ppermute chain) on the
     8-device mesh must show nonzero comm share."""

@@ -66,12 +66,31 @@ def make_local_step(model, opt, base_key, exchanger=None, stacked=False,
     come back per-worker (stacked) — averaging happens on host at print time.
     ``param_specs`` (tensor parallelism) makes gradient clipping's global
     norm exact across model shards (see :func:`ops.opt.global_sq_norm`).
+
+    ``n_subb`` in the model config (reference contract: the file-batch was
+    trained in ``n_subb`` sub-batches with cumulative gradients —
+    SURVEY.md §2.3/§2.4.1) enables gradient accumulation: the per-worker
+    batch is split into ``n_subb`` micro-batches and a ``lax.scan`` runs
+    forward+backward per micro-batch, summing gradients and threading
+    model state sequentially, with ONE exchange and ONE optimizer update
+    per step.  Activation memory is per-micro-batch — on TPU this is the
+    lever for large effective batches at fixed HBM.  Numerics: with
+    per-example normalization (LN) the accumulated mean gradient equals
+    the full-batch gradient exactly; with batch-statistic layers (BN)
+    statistics are per-micro-batch, the same semantics the reference's
+    sub-batched training had.
     """
+    n_subb = int(model.config.get("n_subb", 1) or 1)
 
     # models with a non-standard update (e.g. the GAN two-optimizer step)
     # supply the whole inner step; the rule still owns layout and reduction
     custom = getattr(model, "make_custom_step", None)
     inner = custom(opt, base_key, exchanger) if custom is not None else None
+    if inner is not None and n_subb > 1:
+        raise ValueError(
+            f"n_subb={n_subb} requires the standard grad step; "
+            f"{type(model).__name__} supplies make_custom_step"
+        )
 
     def local_step(params, state, opt_state, batch, lr, step):
         if stacked:
@@ -89,12 +108,17 @@ def make_local_step(model, opt, base_key, exchanger=None, stacked=False,
             axes = exchanger.axis_name if exchanger is not None else DATA_AXIS
             rng = replica_rng(jax.random.fold_in(base_key, step), axes)
 
-            def lossw(p):
-                return model.loss_fn(p, state, batch, rng, train=True)
+            if n_subb == 1:
+                def lossw(p):
+                    return model.loss_fn(p, state, batch, rng, train=True)
 
-            (_, (new_state, metrics)), grads = jax.value_and_grad(
-                lossw, has_aux=True
-            )(params)
+                (_, (new_state, metrics)), grads = jax.value_and_grad(
+                    lossw, has_aux=True
+                )(params)
+            else:
+                new_state, metrics, grads = _accumulated_grads(
+                    model, params, state, batch, rng, n_subb
+                )
             if exchanger is not None:
                 grads = exchanger.exchange(grads)
             new_params, new_opt_state = opt.update(
@@ -115,6 +139,57 @@ def make_local_step(model, opt, base_key, exchanger=None, stacked=False,
         return new_params, new_state, new_opt_state, metrics
 
     return local_step
+
+
+def _accumulated_grads(model, params, state, batch, rng, n_subb):
+    """Micro-batched forward+backward: -> (new_state, metrics, mean grads).
+
+    One compiled ``lax.scan`` over ``n_subb`` micro-batches — activations
+    live only for the current micro-batch; the gradient accumulator is one
+    params-sized tree.  State (BN running stats) threads sequentially
+    through the scan; float metrics come back micro-batch-averaged.
+    """
+    leading = {leaf.shape[0] for leaf in jax.tree.leaves(batch)}
+    if any(b % n_subb for b in leading):
+        raise ValueError(
+            f"n_subb={n_subb} must divide the per-worker batch "
+            f"(got leading dims {sorted(leading)})"
+        )
+
+    def split(x):
+        return x.reshape(n_subb, x.shape[0] // n_subb, *x.shape[1:])
+
+    micro = jax.tree.map(split, batch)
+
+    def one(carry, xs):
+        st, gsum = carry
+        mb, i = xs
+
+        def lossw(p):
+            # a fresh fold per micro-batch: dropout masks must differ
+            # across micro-batches like they do across examples
+            return model.loss_fn(p, st, mb, jax.random.fold_in(rng, i),
+                                 train=True)
+
+        (_, (new_st, m)), g = jax.value_and_grad(lossw, has_aux=True)(params)
+        return (new_st, jax.tree.map(jnp.add, gsum, g)), m
+
+    gsum0 = jax.tree.map(jnp.zeros_like, params)
+    (new_state, gsum), mstk = jax.lax.scan(
+        one, (state, gsum0), (micro, jnp.arange(n_subb))
+    )
+    grads = jax.tree.map(lambda g: g / n_subb, gsum)
+    metrics = jax.tree.map(
+        lambda m: (jnp.mean(m, axis=0)
+                   if jnp.issubdtype(m.dtype, jnp.inexact) else m[-1]),
+        mstk,
+    )
+    # perplexity is exp(loss): mean-of-exp over micro-batches would bias it
+    # high vs an n_subb=1 run (Jensen) — re-derive from the averaged cost,
+    # which is exactly what the unaccumulated path reports
+    if isinstance(metrics, dict) and {"perplexity", "cost"} <= metrics.keys():
+        metrics["perplexity"] = jnp.exp(metrics["cost"])
+    return new_state, metrics, grads
 
 
 def make_local_eval(model, axes=DATA_AXIS):
