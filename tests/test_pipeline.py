@@ -117,17 +117,32 @@ def test_dp2_pp2_tp2_trains():
     assert len(costs) == 1 and all(np.isfinite(costs)), costs
 
 
-def test_pipeline_rejects_seq_parallel_mesh():
-    """The still-uncomposed seq axis must refuse loudly, not silently
-    corrupt gradients."""
+def test_pp2_sp2_matches_single_device():
+    """dp1 x pp2 x sp2: ring attention's KV laps inside the GPipe schedule
+    (VERDICT r3 #5 — the last refusal on the parallelism surface).  Multi-
+    step equivalence: steps 2-3 run on updated params, so a wrong hop
+    order / mis-pinned cotangent on either ring diverges the loss."""
+    mesh1 = make_mesh(n_data=1, devices=jax.devices()[:1])
+    t1, c1 = _run_steps(mesh1, dict(CFG))
+
+    cfg = {**CFG, "seq_parallel": True}
     mesh = make_mesh(n_data=1, n_pipe=2, n_seq=2, devices=jax.devices()[:4])
-    model = PipelineTransformerLM({**CFG, "n_layers": 2})
+    t2, c2 = _run_steps(mesh, cfg)
+    np.testing.assert_allclose(c1, c2, rtol=2e-4, atol=2e-5)
+    a = np.asarray(jax.tree.leaves(t1.params["head"])[0])
+    b = np.asarray(jax.tree.leaves(t2.params["head"])[0])
+    np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
+def test_dp2_pp2_sp2_trains():
+    """All of data x pipe x seq on the 8-device mesh: finite loss, val runs."""
+    mesh = make_mesh(n_data=2, n_pipe=2, n_seq=2)
+    model = PipelineTransformerLM(
+        {**CFG, "seq_parallel": True, "n_epochs": 1})
     t = BSPTrainer(model, mesh=mesh)
-    with pytest.raises(ValueError, match="does not compose"):
-        t.compile_iter_fns()
-        t.init_state()
-        batch = next(iter(model.data.train_batches(t.global_batch, 0, seed=0)))
-        t.train_iter(batch, lr=1e-2)
+    rec = t.run()
+    costs = rec.val_history["cost"]
+    assert len(costs) == 1 and all(np.isfinite(costs)), costs
 
 
 def test_pipeline_rejects_indivisible_microbatch():

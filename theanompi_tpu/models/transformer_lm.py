@@ -341,9 +341,18 @@ class PipelineTransformerLM(TransformerLM):
     The two pinned-VJP families compose because they act on disjoint axes:
     pipeline's f/g pin ``pipe`` (stage-0 injection / last-stage output),
     Megatron's f/g pin ``model`` (column inputs / row outputs) — each
-    collective is an identity over the other's axis.  The ``seq`` axis is
-    still refused: ring attention's hop order inside the GPipe scan is
-    untested, and a silent mis-compose would corrupt gradients.
+    collective is an identity over the other's axis.
+
+    **Sequence parallelism composes too** (VERDICT r3 #5 lifted the old
+    refusal): ring attention's ppermutes ride the ``seq`` axis only and the
+    GPipe schedule's ride ``pipe`` only, and because every device traces the
+    SAME SPMD program, each pipeline schedule step runs the full KV ring
+    (and, in reverse, the full backward ring) in lockstep across seq peers
+    at every pipe rank — there is no cross-axis hop interleaving to get
+    wrong.  The ring's custom VJP pins ``seq`` (dk/dv land home after a
+    full lap), pipeline's pins ``pipe``, Megatron's pins ``model``: three
+    disjoint-axis families.  Verified by the pp2×sp2 ≡ single-device
+    multi-step test (``tests/test_pipeline.py``).
     """
 
     default_config = {
@@ -411,14 +420,9 @@ class PipelineTransformerLM(TransformerLM):
         from theanompi_tpu.parallel.tensor import axis_bound
 
         cfg = self.config
-        # tensor parallelism composes (stacked Megatron specs + disjoint
-        # pinned-VJP axes — see class docstring); sequence parallelism is
-        # still refused rather than risking silent gradient corruption
-        if axis_bound("seq") and jax.lax.axis_size("seq") > 1:
-            raise ValueError(
-                "PipelineTransformerLM does not compose with a sharded"
-                " 'seq' axis yet; use n_seq=1"
-            )
+        # tensor AND sequence parallelism compose (disjoint pinned-VJP
+        # axes — see class docstring); the blocks' ring attention runs its
+        # seq-axis KV laps inside every GPipe schedule step
         emb, _ = self._embed.apply(params["embed"], {}, x)
         emb, _ = self._pos.apply(params["pos"], {}, emb)
 
