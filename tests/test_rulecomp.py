@@ -118,14 +118,61 @@ def test_lr_sweep_reports_each_rule_at_its_best(mesh8):
     )
     row = art["results"][0]
     assert art["lr_sweep"] == [0.005, 0.05]
-    assert len(row["lr_sweep"]) == 2
+    assert len(row["sweep"]) == 2
     assert row["base_lr"] in (0.005, 0.05)
-    swept = {s["base_lr"] for s in row["lr_sweep"]}
+    swept = {s["base_lr"] for s in row["sweep"]}
     assert swept == {0.005, 0.05}
     # the chosen row must be at least as good as every swept row on the
     # primary criteria (reached, then epochs-to-target)
-    if any(s["reached"] for s in row["lr_sweep"]):
+    if any(s["reached"] for s in row["sweep"]):
         assert row["reached"]
-        best_epochs = min(s["epochs_to_target"] for s in row["lr_sweep"]
+        best_epochs = min(s["epochs_to_target"] for s in row["sweep"]
                           if s["reached"])
         assert row["epochs_to_target"] == best_epochs
+
+
+def test_rule_config_sweep_crosses_with_lr(mesh8):
+    """VERDICT r3 #8 machinery: a 4-tuple ruleset sweeps rule-config
+    overrides jointly with lr, and each swept row records its overrides."""
+    from theanompi_tpu.utils.rulecomp import compare_rules
+
+    art = compare_rules(
+        devices=8,
+        model_config=dict(FAST),
+        target_error=0.9,
+        max_epochs=1,
+        rules=[("easgd_tau4", "EASGD", {"tau": 4},
+                [{"alpha": 0.05}, {"alpha": 0.3}])],
+        lr_sweep=(0.01, 0.05),
+        verbose=False,
+    )
+    row = art["results"][0]
+    assert len(row["sweep"]) == 4  # 2 lrs x 2 alphas
+    combos = {(s["base_lr"], s["rule_overrides"]["alpha"])
+              for s in row["sweep"]}
+    assert combos == {(0.01, 0.05), (0.01, 0.3), (0.05, 0.05), (0.05, 0.3)}
+
+
+def test_localsgd_rule_averages_params(mesh8):
+    """The EASGD control: after one exchange, all worker copies equal the
+    pre-exchange mean (plain averaging, no elastic force)."""
+    import jax
+    import numpy as np
+
+    from theanompi_tpu import LocalSGD
+
+    rule = LocalSGD(config={"tau": 2, "seed": 0, "verbose": False})
+    rule.init(devices=8, modelfile="theanompi_tpu.models.wide_resnet",
+              modelclass="WideResNet",
+              model_config={**FAST, "n_epochs": 1})
+    t = rule.trainer
+    # two local steps diverge the workers (per-worker rng), third triggers
+    # the tau=2 exchange inside post_step at iteration 2
+    batches = list(t.model.data.train_batches(t.global_batch, 0, seed=0))
+    t.train_iter(batches[0], lr=0.05)
+    leaf = np.asarray(jax.tree.leaves(t.params)[0])
+    assert not np.allclose(leaf[0], leaf[1]), "workers did not diverge"
+    t.train_iter(batches[1 % len(batches)], lr=0.05)  # iteration 2 -> avg
+    leaf = np.asarray(jax.tree.leaves(t.params)[0])
+    np.testing.assert_allclose(leaf[0], leaf[1], rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(leaf[0], leaf.mean(0), rtol=1e-6, atol=1e-7)

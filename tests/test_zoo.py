@@ -109,6 +109,37 @@ def test_googlenet_aux_heads():
     assert float(train_loss) > float(eval_loss)
 
 
+def test_googlenet_bn_knob():
+    """bn=True (BN-GoogLeNet / Inception-v2 recipe): BN state exists, LRN
+    and conv biases are dropped, one BSP step is finite and sync-BN rides
+    the data axis on a multi-worker mesh (same knob surface as VGG-11)."""
+    from theanompi_tpu.models.googlenet import GoogLeNet
+    from theanompi_tpu.parallel.mesh import DATA_AXIS
+
+    cfg = {**COMMON, "image_size": 64, "n_classes": 13, "lrn": True,
+           "bn": True, "bn_axis": DATA_AXIS, "batch_size": 2}
+    model = GoogLeNet(cfg)
+    _, state = model.init_params(jax.random.PRNGKey(0))
+    assert state, "bn=True produced no BN state"
+    # biases gone from convs (BN owns the shift)
+    flat = dict(
+        ("/".join(str(getattr(p, "key", p)) for p in path), leaf)
+        for path, leaf in
+        jax.tree_util.tree_flatten_with_path(model.init_params(
+            jax.random.PRNGKey(0))[0])[0]
+    )
+    conv_biases = [k for k in flat if "conv" in k and k.endswith("/b")]
+    assert not conv_biases, f"bn=True kept conv biases: {conv_biases[:3]}"
+
+    mesh = make_mesh(n_data=2, devices=jax.devices()[:2])
+    t = BSPTrainer(model, mesh=mesh)
+    t.compile_iter_fns()
+    t.init_state()
+    batch = next(iter(model.data.train_batches(t.global_batch, 0, seed=0)))
+    m = t.train_iter(batch, lr=0.01)
+    assert np.isfinite(float(m["cost"]))
+
+
 def test_googlenet_aux_full_size_pool_shape():
     """At 224 the aux tap is 14x14 -> the paper's 5x5/3 pool path is used."""
     from theanompi_tpu.models.googlenet import GoogLeNet

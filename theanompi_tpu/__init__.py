@@ -28,9 +28,12 @@ _RULES = {
     "BSP": "theanompi_tpu.parallel.bsp",
     "EASGD": "theanompi_tpu.parallel.easgd",
     "GOSGD": "theanompi_tpu.parallel.gosgd",
+    # periodic parameter averaging — EASGD's diagnosis control and a rule
+    # in its own right (k-step averaging)
+    "LocalSGD": "theanompi_tpu.parallel.easgd",
 }
 
-__all__ = ["BSP", "EASGD", "GOSGD", "__version__"]
+__all__ = ["BSP", "EASGD", "GOSGD", "LocalSGD", "__version__"]
 
 
 def __getattr__(name):

@@ -42,6 +42,12 @@ class DCGAN(Model):
         "wgan": False,
         "clip": 0.01,       # WGAN critic weight clip
         "n_critic": 5,      # WGAN critic steps per generator step
+        # two-timescale update rule (TTUR, Heusel et al. 2017): the
+        # discriminator trains at lr * disc_lr_scale.  At small scales a
+        # matched-capacity D saturates before G learns; slowing D (rather
+        # than shrinking it) keeps the game balanced without handicapping
+        # D's capacity
+        "disc_lr_scale": 1.0,
         "augment": False,   # GAN training uses raw images
         "normalize": "tanh",  # reals in [-1,1], matching the tanh generator
     }
@@ -190,7 +196,8 @@ class DCGAN(Model):
             )(params["disc"])
             d_grads = exchange(d_grads)
             new_disc, new_dopt = opt.update(
-                d_grads, opt_state["disc"], params["disc"], lr
+                d_grads, opt_state["disc"], params["disc"],
+                lr * cfg["disc_lr_scale"]
             )
             if wgan:
                 new_disc = jax.tree.map(

@@ -36,19 +36,31 @@ class _Inception(L.Layer):
     """Four parallel branches, concatenated on channels.
 
     ``spec`` = (n1x1, n3x3_reduce, n3x3, n5x5_reduce, n5x5, pool_proj).
+    ``bn`` inserts BatchNorm between every conv and its relu (the
+    Inception-v2 / "BN-GoogLeNet" training recipe) — same knob VGG-11
+    grew for the bounded convergence gate.
     """
 
     spec: tuple
     lrn: bool = False
+    bn: bool = False
+    bn_axis: str | None = None
+
+    def _conv(self, c, k, padding=0):
+        conv = L.Conv2D(c, k, padding=padding, use_bias=not self.bn)
+        relu = L.Activation("relu")
+        if self.bn:
+            return (conv, L.BatchNorm(axis_name=self.bn_axis), relu)
+        return (conv, relu)
 
     def _branches(self):
         n1, r3, n3, r5, n5, pp = self.spec
-        relu = L.Activation("relu")
         return (
-            _branch(L.Conv2D(n1, 1), relu),
-            _branch(L.Conv2D(r3, 1), relu, L.Conv2D(n3, 3, padding=1), relu),
-            _branch(L.Conv2D(r5, 1), relu, L.Conv2D(n5, 5, padding=2), relu),
-            _branch(L.MaxPool(3, stride=1, padding="SAME"), L.Conv2D(pp, 1), relu),
+            _branch(*self._conv(n1, 1)),
+            _branch(*self._conv(r3, 1), *self._conv(n3, 3, padding=1)),
+            _branch(*self._conv(r5, 1), *self._conv(n5, 5, padding=2)),
+            _branch(L.MaxPool(3, stride=1, padding="SAME"),
+                    *self._conv(pp, 1)),
         )
 
     def init(self, key, in_shape):
@@ -183,6 +195,10 @@ class GoogLeNet(SupervisedModel):
         "lrn": True,
         "dropout": 0.4,
         "aux": False,  # paper §5 auxiliary classifiers (train-time only)
+        # BN-GoogLeNet variant: BatchNorm after every conv, biases and LRN
+        # dropped — the trainable-at-small-scale recipe (Inception-v2)
+        "bn": False,
+        "bn_axis": None,
     }
 
     def build_data(self):
@@ -212,17 +228,26 @@ class GoogLeNet(SupervisedModel):
     def build_net(self):
         cfg = self.config
         self.aux = bool(cfg["aux"])
+        bn, bn_axis = bool(cfg["bn"]), cfg["bn_axis"]
         relu = L.Activation("relu")
-        maybe_lrn = [L.LRN(size=5)] if cfg["lrn"] else []
+
+        def conv(c, k, stride=1, padding=0):
+            out: list[L.Layer] = [
+                L.Conv2D(c, k, stride=stride, padding=padding,
+                         use_bias=not bn)]
+            if bn:
+                out.append(L.BatchNorm(axis_name=bn_axis))
+            out.append(relu)
+            return out
+
+        # BN replaces the LRN-era norms entirely (Inception-v2 recipe)
+        maybe_lrn = [L.LRN(size=5)] if (cfg["lrn"] and not bn) else []
         stem: list[L.Layer] = [
-            L.Conv2D(64, 7, stride=2, padding=3),
-            relu,
+            *conv(64, 7, stride=2, padding=3),
             L.MaxPool(3, stride=2, padding="SAME"),
             *maybe_lrn,
-            L.Conv2D(64, 1),
-            relu,
-            L.Conv2D(192, 3, padding=1),
-            relu,
+            *conv(64, 1),
+            *conv(192, 3, padding=1),
             *maybe_lrn,
             L.MaxPool(3, stride=2, padding="SAME"),
         ]
@@ -239,7 +264,7 @@ class GoogLeNet(SupervisedModel):
             if item == "P":
                 segs[seg].append(L.MaxPool(3, stride=2, padding="SAME"))
             else:
-                segs[seg].append(_Inception(item[1]))
+                segs[seg].append(_Inception(item[1], bn=bn, bn_axis=bn_axis))
                 if item[0] == "4a":
                     seg = 1
                 elif item[0] == "4d":

@@ -84,6 +84,12 @@ class EASGDTrainer(BaseTrainer):
         self._exchange_fn = None
         self._consensus_state_fn = None
 
+    def _exchange_pair(self, params, center):
+        """The periodic exchange, on UNSTACKED per-worker params; the
+        local-SGD control subclass overrides this single hook."""
+        new_p, new_c = elastic_exchange(unstack(params), center, self.alpha)
+        return restack(new_p), new_c
+
     def compile_iter_fns(self) -> None:
         local_step = make_local_step(
             self.model, self.optimizer, jax.random.PRNGKey(self.seed),
@@ -92,8 +98,7 @@ class EASGDTrainer(BaseTrainer):
         local_eval = make_local_eval(self.model)
 
         def exchange(params, center):
-            new_p, new_c = elastic_exchange(unstack(params), center, self.alpha)
-            return restack(new_p), new_c
+            return self._exchange_pair(params, center)
 
         def consensus_state(state):
             return pmean_floats(unstack(state), DATA_AXIS)
@@ -146,17 +151,48 @@ class EASGDTrainer(BaseTrainer):
         return {**super().checkpoint_trees(), "center": self.center}
 
 
+class LocalSGDTrainer(EASGDTrainer):
+    """Local SGD / periodic parameter averaging: τ collective-free local
+    steps, then ``p_i ← mean_j(p_j)`` — "BSP exchanging every τ steps".
+
+    Primarily the EASGD-diagnosis control (VERDICT r3 #8): it shares the
+    stacked layout, τ schedule, and exchange cadence with EASGD but
+    replaces the elastic force with a plain average.  If this control
+    reaches a target at a τ where EASGD fails at every α, the elastic
+    coupling is what fails; if neither reaches it, τ-stale exchange itself
+    does at that scale.  (Also a useful rule in its own right — the
+    k-step-averaging family.)  The ``center`` is kept equal to the average
+    so validation-with-center semantics match EASGD's.
+    """
+
+    def _exchange_pair(self, params, center):
+        avg = pmean_floats(unstack(params), DATA_AXIS)
+        return restack(avg), avg
+
+
 class EASGD(Rule):
     """Elastic-averaging rule.  Config: ``tau``, ``alpha``, ``scale_lr``."""
 
+    trainer_cls = EASGDTrainer
+    #: the reference EASGD worker scaled LR by worker count; the local-SGD
+    #: control doesn't (its baseline is BSP, which trains at base LR)
+    scale_lr_default = True
+
     def make_trainer(self, model, mesh, recorder) -> EASGDTrainer:
         n = mesh.shape[DATA_AXIS]
-        if n > 1 and self.config.get("scale_lr", True):
+        if n > 1 and self.config.get("scale_lr", self.scale_lr_default):
             model.scale_lr(n)  # reference EASGD worker hook
-        return EASGDTrainer(
+        return self.trainer_cls(
             model,
             mesh=mesh,
             tau=self.config.get("tau", 4),
             alpha=self.config.get("alpha"),
             **self.common_trainer_kwargs(recorder),
         )
+
+
+class LocalSGD(EASGD):
+    """Periodic-averaging rule (the EASGD control).  Config: ``tau``."""
+
+    trainer_cls = LocalSGDTrainer
+    scale_lr_default = False

@@ -481,6 +481,11 @@ class BaseTrainer:
             for k, v in m.items():
                 accums.setdefault(k, []).append(v)
         means = {k: float(np.mean([float(x) for x in v])) for k, v in accums.items()}
+        # perplexity is exp(loss): the arithmetic mean of per-batch
+        # perplexities is Jensen-biased high — re-derive from the averaged
+        # cost (same fix the micro-batch accumulation path applies)
+        if {"perplexity", "cost"} <= means.keys():
+            means["perplexity"] = float(np.exp(means["cost"]))
         self.recorder.val_metrics(epoch, **means)
         return means
 

@@ -6,13 +6,17 @@ step of the ImageNet-class models in CI.  This harness trains, in bounded
 minutes on the virtual mesh:
 
 - **ResNet-50** (small-image head: 64 px, 10-class synthetic shards),
-  **AlexNet with grouped convs**, and **VGG-11 (+BN)** to fixed
-  validation-error targets under the BSP rule, reusing the rulecomp
-  train-to-target machinery;
-- **DCGAN** for a few epochs, then records a sample-quality proxy:
-  per-pixel std across generated samples (mode-collapse detector — a
-  collapsed generator emits near-identical images) and the discriminator's
-  real-vs-fake score gap (a converging GAN keeps D near chance).
+  **AlexNet with grouped convs**, **VGG-11 (+BN)** and **GoogLeNet (+BN)**
+  to fixed validation-error targets under the BSP rule, reusing the
+  rulecomp train-to-target machinery;
+- **LSTM and Transformer LMs** to a fixed validation PERPLEXITY target on
+  the synthetic PTB stand-in, with the stream's computable entropy floor
+  recorded next to the target (VERDICT r3 #7);
+- **DCGAN** with a capacity-MATCHED discriminator balanced by the
+  two-timescale update rule, gated on real-relative sample diversity, the
+  discriminator's real-vs-fake score gap, and a sliced-Wasserstein
+  distribution statistic calibrated against a real split-half baseline
+  (VERDICT r3 #9).
 
 Writes ``CONVERGE.json`` with the full val-error curves, the proxy values,
 and explicit pass/fail per model.  CLI::
@@ -60,20 +64,94 @@ CLASSIFIER_RUNS = [
          "lr_decay_epochs": (), "weight_decay": 0.0, "precision": "fp32"},
         0.35, 20,
     ),
+    (
+        # the BN knob (VERDICT r3 #6): plain GoogLeNet was excluded in r3
+        # (best val err 0.64 after 20 epochs — no-BN trainability, not a
+        # model bug); BN-GoogLeNet memorizes a batch in <40 steps where
+        # no-BN plateaued at err 0.69, and converges inside the gate
+        "googlenet_bn",
+        "theanompi_tpu.models.googlenet", "GoogLeNet",
+        {"image_size": 64, "store_size": 72, "n_classes": 10,
+         "batch_size": 16, "n_train": 512, "n_val": 128, "shard_size": 128,
+         "bn": True, "dropout": 0.2, "lr": 0.01,
+         "lr_decay_epochs": (), "weight_decay": 0.0, "precision": "fp32"},
+        0.35, 20,
+    ),
 ]
 
 #: models deliberately NOT in the bounded harness, with why (emitted into
 #: the artifact so regeneration preserves the record)
-EXCLUDED = {
-    "googlenet_aux": (
-        "learns but converges too slowly for the bounded-minutes gate at "
-        "the 512-image/64px no-BN scale: probed best val error 0.64 after "
-        "20 epochs at lr 2e-3 and 0.77 after 12 at lr 1e-3/5e-3; "
-        "correctness is covered by the aux-head gradient-flow tests "
-        "(tests/test_zoo.py), full convergence needs the real-data scale "
-        "the reference used"
+EXCLUDED: dict[str, str] = {}
+
+#: sequence models trained to a PERPLEXITY target on the synthetic PTB
+#: stand-in (VERDICT r3 #7 — the reference trained its LSTM to PTB
+#: perplexity; zero-egress image, so the bigram stream with a computable
+#: entropy floor substitutes).  (name, modelfile, modelclass, config,
+#: target_ppl, max_epochs).  Floor at vocab 64 is exp(H) = 13.3; targets
+#: sit between floor and the unigram ~55, so reaching them requires
+#: actually learning the transition structure.
+SEQUENCE_RUNS = [
+    (
+        # lr 1.0 + momentum 0.9: probed to reach train ppl ~13 (the floor)
+        # in ~600 steps; lr 1.0/no-momentum creeps (ppl 57 after 120)
+        "lstm_ptb_synth",
+        "theanompi_tpu.models.lstm", "LSTM",
+        {"batch_size": 8, "n_train": 2048, "n_val": 256, "seq_len": 32,
+         "vocab": 64, "hidden": 128, "embed_dim": 128, "n_layers": 1,
+         "dropout": 0.0, "lr": 1.0, "momentum": 0.9,
+         "lr_decay_epochs": (), "grad_clip": 5.0, "precision": "fp32"},
+        16.0, 25,
     ),
-}
+    (
+        "transformer_ptb_synth",
+        "theanompi_tpu.models.transformer_lm", "TransformerLM",
+        {"batch_size": 8, "n_train": 2048, "n_val": 256, "seq_len": 32,
+         "vocab": 64, "dim": 128, "heads": 4, "n_layers": 2,
+         "dropout": 0.0, "lr": 0.01, "momentum": 0.9,
+         "lr_decay_epochs": (), "grad_clip": 1.0, "precision": "fp32",
+         "attn_impl": "blockwise"},
+        16.0, 15,
+    ),
+]
+
+
+def _bigram_floor_ppl(vocab: int, seed: int = 0) -> float:
+    """exp(entropy rate) of the synthetic bigram stream — the perplexity a
+    perfect model of the data would reach."""
+    from theanompi_tpu.models.data.base import SyntheticSequenceDataset
+
+    syn = SyntheticSequenceDataset(vocab=vocab, seed=seed)
+    p = syn._probs
+    pi = np.ones(vocab) / vocab
+    for _ in range(200):
+        pi = pi @ p
+    h = -(pi[:, None] * p * np.log(np.maximum(p, 1e-12))).sum()
+    return float(np.exp(h))
+
+
+def converge_sequence_models(devices=8, runs=None, verbose=True) -> list[dict]:
+    from theanompi_tpu import BSP
+    from theanompi_tpu.utils.rulecomp import run_to_target
+
+    rows = []
+    for name, mf, mc, cfg, target, max_epochs in (runs or SEQUENCE_RUNS):
+        rule = BSP(config={"seed": 0, "verbose": False})
+        row = run_to_target(
+            rule, devices=devices, model_config=dict(cfg),
+            target_error=target, max_epochs=max_epochs,
+            modelfile=mf, modelclass=mc, metric="perplexity",
+        )
+        row = {"model": name, "target_perplexity": target,
+               "entropy_floor_perplexity":
+                   round(_bigram_floor_ppl(cfg["vocab"]), 2),
+               "passed": row["reached"], **row}
+        rows.append(row)
+        if verbose:
+            print(json.dumps({k: row[k] for k in
+                              ("model", "passed", "epochs_to_target",
+                               "best_val_error",
+                               "entropy_floor_perplexity")}), flush=True)
+    return rows
 
 
 def converge_classifiers(devices=8, runs=None, verbose=True) -> list[dict]:
@@ -98,16 +176,44 @@ def converge_classifiers(devices=8, runs=None, verbose=True) -> list[dict]:
     return rows
 
 
-def converge_dcgan(devices=8, n_epochs=30, verbose=True) -> dict:
-    """Train DCGAN briefly; -> curves + sample-quality proxy row.
+def _sliced_wasserstein(a: np.ndarray, b: np.ndarray, n_proj: int = 64,
+                        seed: int = 0) -> float:
+    """1-sliced-Wasserstein distance between two equal-size sample sets:
+    mean |sorted projections| gap over random unit directions.  A
+    distribution-level statistic — sensitive to mode collapse and mean/
+    scale drift at once, cheap enough for the bounded harness."""
+    rng = np.random.RandomState(seed)
+    a = a.reshape(len(a), -1).astype(np.float64)
+    b = b.reshape(len(b), -1).astype(np.float64)
+    proj = rng.randn(a.shape[1], n_proj)
+    proj /= np.linalg.norm(proj, axis=0, keepdims=True)
+    pa = np.sort(a @ proj, axis=0)
+    pb = np.sort(b @ proj, axis=0)
+    return float(np.mean(np.abs(pa - pb)))
 
-    Proxies (both cheap, both catch the classic failure modes):
-    - ``sample_std``: mean per-pixel std across 64 generated samples in
-      the tanh [-1, 1] range.  Mode collapse drives it toward 0; the
-      synthetic CIFAR reals sit around ~0.3.
-    - ``disc_gap``: |sigmoid(D(real)) - sigmoid(D(fake))| batch means — a
-      discriminator that cleanly separates real from fake (gap -> 1)
-      means the generator lost; training health keeps it moderate.
+
+def converge_dcgan(devices=8, n_epochs=15, verbose=True) -> dict:
+    """Train DCGAN with a MATCHED discriminator; -> curves + proxy row.
+
+    VERDICT r3 #9: the old evidence passed by under-building D
+    (disc_base 16 vs gen_base 64).  The balanced setting is now capacity-
+    matched (64/64) with the two-timescale update rule instead —
+    ``disc_lr_scale 0.25`` (measured at this scale: a matched D at equal
+    LRs saturates to gap 0.98 by epoch 30; at 0.25x it holds gap ~0.2
+    while G learns).  Training stops at the measured balance window
+    (proxies tracked over 90 epochs: std 0.086/gap 0.18 at ep 15 decaying
+    to std 0.037/gap 0.71 by ep 75 — tiny-data GANs degrade past the
+    window, so a bounded run is the honest setting).
+
+    Proxies, all thresholds away from their failure bounds:
+    - ``std_ratio`` = sample_std / real_std (real-relative, not absolute:
+      collapse sits at ~0.24 here, healthy ~0.4; gate at 0.33);
+    - ``disc_gap`` |sigmoid(D(real)) - sigmoid(D(fake))|: saturation = 1,
+      gate at 0.8;
+    - ``swd_fake_real`` vs the ``swd_real_real`` split-half baseline:
+      a distribution-level statistic (sliced Wasserstein) comparing the
+      generated set against the real set, calibrated by how far apart two
+      real halves sit.
     """
     import jax
     import jax.numpy as jnp
@@ -117,13 +223,9 @@ def converge_dcgan(devices=8, n_epochs=30, verbose=True) -> dict:
     from theanompi_tpu.parallel.mesh import make_mesh
     from theanompi_tpu.utils.recorder import Recorder
 
-    # disc_base < gen_base: at this tiny scale a matched discriminator
-    # saturates (gap -> 0.96) before the generator learns; weakening D
-    # keeps the game balanced (measured: gap 0.49 with std 0.08 at 30
-    # epochs vs gap 0.96 matched)
-    cfg = {"batch_size": 8, "image_size": 32, "gen_base": 64, "disc_base": 16,
+    cfg = {"batch_size": 8, "image_size": 32, "gen_base": 64, "disc_base": 64,
            "z_dim": 32, "n_train": 256, "n_val": 64, "n_epochs": n_epochs,
-           "precision": "fp32", "verbose": False}
+           "disc_lr_scale": 0.25, "precision": "fp32", "verbose": False}
     model = DCGAN(cfg)
     mesh = make_mesh(n_data=devices)
     # print_freq=8: train_history only fills at print boundaries (the
@@ -143,6 +245,7 @@ def converge_dcgan(devices=8, n_epochs=30, verbose=True) -> dict:
     sample_std = float(np.mean(fake.std(axis=0)))
 
     real = next(iter(model.data.val_batches(64)))["x"].astype(np.float32)
+    real_std = float(np.mean(real.std(axis=0)))
     s_real, _ = model.disc.apply(cast(params["disc"]), trainer.state["disc"],
                                  jnp.asarray(real))
     s_fake, _ = model.disc.apply(cast(params["disc"]), trainer.state["disc"],
@@ -151,21 +254,38 @@ def converge_dcgan(devices=8, n_epochs=30, verbose=True) -> dict:
         return 1.0 / (1.0 + np.exp(-np.asarray(a, np.float32)))
 
     gap = float(abs(np.mean(sigmoid(s_real)) - np.mean(sigmoid(s_fake))))
+    std_ratio = sample_std / max(real_std, 1e-6)
+    # both statistics at the SAME sample size (32 vs 32): finite-sample
+    # SWD shrinks with n, so a 64-vs-64 fake/real distance against a
+    # 32-vs-32 baseline would make the gate silently looser
+    swd_fr = _sliced_wasserstein(fake[::2], real[::2])
+    swd_rr = _sliced_wasserstein(real[::2], real[1::2])
     row = {
-        "model": "dcgan",
+        "model": "dcgan_matched",
         "epochs": n_epochs,
+        "gen_base": cfg["gen_base"], "disc_base": cfg["disc_base"],
+        "disc_lr_scale": cfg["disc_lr_scale"],
         "d_loss_curve": [round(float(v), 4)
                          for v in rec.train_history.get("d_loss", [])][-50:],
         "g_loss_curve": [round(float(v), 4)
                          for v in rec.train_history.get("g_loss", [])][-50:],
         "sample_std": round(sample_std, 4),
+        "real_std": round(real_std, 4),
+        "std_ratio": round(std_ratio, 4),
         "disc_gap": round(gap, 4),
-        # pass: generator not collapsed AND discriminator not saturated
-        "passed": bool(sample_std > 0.05 and gap < 0.95),
+        "swd_fake_real": round(swd_fr, 4),
+        "swd_real_real": round(swd_rr, 4),
+        # pass: not collapsed (real-relative), D not saturated, and the
+        # generated DISTRIBUTION within 4x the real split-half distance
+        # (measured healthy run: 2.4x; collapse blows the sorted-projection
+        # gaps up along with the std ratio)
+        "passed": bool(std_ratio > 0.33 and gap < 0.8
+                       and swd_fr < 4.0 * swd_rr),
     }
     if verbose:
         print(json.dumps({k: row[k] for k in
-                          ("model", "passed", "sample_std", "disc_gap")}),
+                          ("model", "passed", "std_ratio", "disc_gap",
+                           "swd_fake_real", "swd_real_real")}),
               flush=True)
     return row
 
@@ -173,7 +293,7 @@ def converge_dcgan(devices=8, n_epochs=30, verbose=True) -> dict:
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--devices", type=int, default=8)
-    p.add_argument("--dcgan-epochs", type=int, default=30)
+    p.add_argument("--dcgan-epochs", type=int, default=15)
     p.add_argument("--out", default="CONVERGE.json")
     p.add_argument("--force-host-devices", type=int, default=None)
     args = p.parse_args(argv)
@@ -182,6 +302,7 @@ def main(argv=None):
 
         force_host_devices(args.force_host_devices)
     rows = converge_classifiers(devices=args.devices)
+    rows += converge_sequence_models(devices=args.devices)
     rows.append(converge_dcgan(devices=args.devices,
                                n_epochs=args.dcgan_epochs))
     art = {"devices": args.devices, "results": rows,
