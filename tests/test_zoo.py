@@ -154,6 +154,64 @@ def test_googlenet_aux_full_size_pool_shape():
     assert isinstance(head.layers[1], L.Conv2D)
 
 
+def test_resnet50_s2d_stem_matches_conv7():
+    """The space-to-depth stem is the SAME linear map as the 7x7/2 conv
+    (MLPerf trick, kept in the logical [7,7,C,F] param layout): forward
+    and gradient must match to fp tolerance."""
+    import jax.numpy as jnp
+
+    from theanompi_tpu.models.resnet50 import _SpaceToDepthStem
+    from theanompi_tpu.ops import layers as L
+
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 64, 64, 3)
+                    .astype(np.float32))
+    stem = _SpaceToDepthStem(16)
+    params, _, out_shape = stem.init(jax.random.PRNGKey(3), (64, 64, 3))
+    ref = L.Conv2D(16, 7, stride=2, padding=3, use_bias=False)
+    y_s2d, _ = stem.apply(params, {}, x)
+    y_ref, _ = ref.apply({"w": params["w"]}, {}, x)
+    assert y_s2d.shape == (2, *out_shape)
+    np.testing.assert_allclose(np.asarray(y_s2d), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-4)
+    g1 = jax.grad(lambda w: jnp.sum(jnp.sin(
+        stem.apply({"w": w}, {}, x)[0])))(params["w"])
+    g2 = jax.grad(lambda w: jnp.sum(jnp.sin(
+        ref.apply({"w": w}, {}, x)[0])))(params["w"])
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_resnet50_remat_matches_none():
+    """remat='save_convs' is a scheduling knob, not a numerics knob: two
+    train steps must reproduce the default path's params exactly."""
+    cfg = {"image_size": 32, "n_classes": 9, "stage_blocks": (1, 1, 1, 1),
+           "batch_size": 4, "n_train": 32, "n_val": 16, "shard_size": 16,
+           "n_epochs": 1, "precision": "fp32"}
+
+    def run(remat):
+        from theanompi_tpu.models.resnet50 import ResNet50
+
+        model = ResNet50({**cfg, "remat": remat})
+        t = BSPTrainer(model,
+                       mesh=make_mesh(n_data=1, devices=jax.devices()[:1]))
+        t.compile_iter_fns()
+        t.init_state()
+        batches = list(model.data.train_batches(t.global_batch, 0, seed=0))
+        for i in range(2):
+            m = t.train_iter(batches[i % len(batches)], lr=0.05)
+        return t.params, float(m["cost"])
+
+    p0, c0 = run("none")
+    p1, c1 = run("save_convs")
+    assert c0 == c1
+    for (path, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(p0),
+            jax.tree_util.tree_leaves_with_path(p1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-6, atol=2e-7,
+                                   err_msg=str(path))
+
+
 def test_alexnet_grouped_convs():
     """grouped=True: 2-group conv2/4/5 (Krizhevsky split) — fewer params,
     still trains."""

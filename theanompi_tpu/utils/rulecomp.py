@@ -193,9 +193,15 @@ def compare_rules(devices=8, model_config: dict | None = None,
 
 #: α grid for the τ>1 diagnosis: 0.1125 is the old pinned default (0.9/8
 #: per the EASGD paper's β=0.9); 0.05 couples looser, 0.3/0.5 tighter —
-#: the paper's claim is that larger τ stays competitive with TUNED α
+#: the paper's claim is that larger τ stays competitive with TUNED α.
+#: The two ``scale_lr: False`` arms remove the remaining LR confound: with
+#: the reference hook on, EASGD trains at 8x the base LR, so its effective
+#: range would not overlap the LocalSGD control's at all and an LR-window
+#: failure would masquerade as an elastic-coupling failure.
 ALPHA_SWEEP = [{"alpha": 0.05}, {"alpha": 0.1125}, {"alpha": 0.3},
-               {"alpha": 0.5}]
+               {"alpha": 0.5},
+               {"alpha": 0.1125, "scale_lr": False},
+               {"alpha": 0.3, "scale_lr": False}]
 
 
 def _diagnose(results: list[dict]) -> list[str]:
