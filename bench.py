@@ -26,15 +26,18 @@ Measurement protocol (matters on TPU, doubly so through a remote tunnel):
   transfer (on this tunnel, transfers contend with dispatch on one link, so
   this mode understates a real TPU VM's pipeline; synthetic-data RNG stays
   outside the timed loop in both modes).
-- **MFU from the compiler.**  FLOPs/step comes from XLA's cost analysis of
-  the compiled step executable (fallback: an analytic table), divided by the
-  measured step time and the chip's peak.
+- **MFU accounting.**  Conv nets: FLOPs/step from XLA's cost analysis of
+  the compiled step (fallback: an analytic table).  Transformer: fully
+  analytic STRICT model flops (3x theoretical forward, no remat credit) —
+  cost analysis counts Pallas custom-calls as zero AND scan bodies once
+  instead of per trip, both of which understate the LM step.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -45,12 +48,19 @@ import jax.numpy as jnp
 # round-1 guess).  Backfill real reference numbers if the reference mount is
 # ever fixed.
 NOMINAL = {
-    ("wide_resnet", "tpu"): 4000.0,    # round-1 nominal (never re-measured)
+    ("wide_resnet", "tpu"): 25044.5,   # round 4, measured (replaces the
+    #                                    round-1 guess of 4000 — VERDICT r3
+    #                                    weak #4; trial spread 20.1-25.0k
+    #                                    on the shared chip, best-of kept)
     ("wide_resnet", "cpu"): 40.0,
-    ("resnet50", "tpu"): 2473.4,       # round 2, BENCH_r02.json
+    ("resnet50", "tpu"): 2481.5,       # round 3, BENCH_r03.json
     ("resnet50", "cpu"): 4.0,
-    # transformer rows are tokens/sec (unit switches with the model)
-    ("transformer", "tpu"): 290_000.0,  # round 2, BASELINE.md ladder
+    # transformer rows are tokens/sec (unit switches with the model).
+    # Round 4 re-baselined the config to vocab 32k + fused loss (the real
+    # LM setting — r3's 290k was measured at the V=2048 toy vocab and is
+    # not comparable); this is the round-4 measured number at the new
+    # default config.
+    ("transformer", "tpu"): 234_000.0,
     ("transformer", "cpu"): 1_000.0,
 }
 
@@ -64,8 +74,9 @@ PEAK_TFLOPS = (
     ("v4", 275.0),
 )
 
-#: analytic fwd+bwd FLOPs per sample (fallback when cost analysis is absent;
-#: the transformer fallback is computed from param count — see main)
+#: analytic fwd+bwd FLOPs per sample for the conv nets (fallback when cost
+#: analysis is absent; the transformer always uses the strict analytic
+#: formula in run_bench instead)
 ANALYTIC_FLOPS = {"resnet50": 3 * 4.1e9, "wide_resnet": 3 * 0.1e9}
 
 
@@ -98,10 +109,14 @@ def build_trainer(model_name: str, platform: str):
         bs = int(bs_env) if bs_env else (16 if platform == "tpu" else 2)
         seq = int(os.environ.get("BENCH_SEQ", "2048" if platform == "tpu"
                                  else "256"))
-        # BENCH_VOCAB >= 8192 flips the model onto the fused chunked
-        # cross-entropy path (the synthetic generator switches to the
-        # procedural-sparse bigram at >4096, so host setup stays cheap)
-        vocab = int(os.environ.get("BENCH_VOCAB", "2048"))
+        # Default vocab 32k on TPU: the REAL configuration — >=8192 flips
+        # the model onto the fused chunked cross-entropy path (VERDICT r3
+        # #3: the old 2048 default measured the naive path at a toy vocab,
+        # the setting the fused loss exists to replace).  The synthetic
+        # generator switches to the procedural-sparse bigram at >4096, so
+        # host setup stays cheap.
+        vocab = int(os.environ.get(
+            "BENCH_VOCAB", "32768" if platform == "tpu" else "2048"))
         cfg = {"batch_size": bs, "seq_len": seq, "vocab": vocab,
                "dim": 512, "heads": 8, "n_layers": 8, "dropout": 0.0,
                "n_train": bs * 8, "n_val": bs * 2}
@@ -135,9 +150,9 @@ def step_flops(trainer, batch) -> float | None:
         return None
 
 
-def main():
+def run_bench(model_name: str) -> dict:
+    """Measure one model; -> the result-line dict (the old main body)."""
     platform = jax.devices()[0].platform
-    model_name = os.environ.get("BENCH_MODEL", "resnet50")
     feed_mode = os.environ.get("BENCH_FEED", "placed")
     # the tunneled chip throttles in multi-second windows: many short
     # trials catch an unthrottled window; best-of is the capability number
@@ -156,30 +171,29 @@ def main():
     m = trainer.train_iter(host_batches[0], lr=0.01)
     float(m["cost"])
 
-    flops = step_flops(trainer, host_batches[0])
-    if flops is None:
-        if model_name == "transformer":
-            # the standard 6·N·D training estimate (D = tokens per step)
-            from theanompi_tpu.utils.helper_funcs import tree_count
-
-            flops = 6.0 * tree_count(trainer.params) * bs * model.config["seq_len"]
-        else:
-            flops = ANALYTIC_FLOPS.get(model_name, 0.0) * bs
-    elif model_name == "transformer" and platform == "tpu":
-        # XLA's cost analysis counts Pallas custom-calls as ZERO flops, so
-        # the attention math (ROOFLINE_transformer.json: ~half the step)
-        # vanishes from MFU when the flash kernels are in use.  Add the
-        # analytic causal attention flops: fwd = 0.5 (causal) * 4*B*H*T^2*Dh
-        # per layer, train total = 3.5x fwd (bwd recomputes s and runs
-        # dq/dkv).
-        from theanompi_tpu.ops.pallas_attention import flash_attention_supported
-
+    if model_name == "transformer":
+        # Fully analytic STRICT model flops (train = 3x the theoretical
+        # forward; rematerialization inside flash-attention and the fused
+        # loss is real work but NOT counted — the PaLM-style MFU
+        # convention).  Cost analysis is unusable here twice over: it
+        # counts Pallas custom-calls as zero flops AND counts each
+        # lax.scan body once instead of per trip, so at V=32k it missed
+        # ~4 TF of the fused-loss head per step (reported MFU 0.26 where
+        # the honest number is ~0.36).
         cfgm = model.config
-        t, dh = cfgm["seq_len"], cfgm["dim"] // cfgm["heads"]
-        if (cfgm.get("attn_impl", "auto") in ("auto", "pallas")
-                and flash_attention_supported(t, dh)):
-            flops += (cfgm["n_layers"] * 3.5 * 0.5 * 4.0
-                      * bs * cfgm["heads"] * t * t * dh)
+        t, d, heads, layers = (cfgm["seq_len"], cfgm["dim"], cfgm["heads"],
+                               cfgm["n_layers"])
+        n_tok = bs * t
+        v = model.data.vocab
+        mm_params = layers * 12 * d * d          # qkvo (4d^2) + ffn (8d^2)
+        trunk = 6.0 * n_tok * mm_params
+        attn = 3.0 * layers * 0.5 * 4.0 * bs * heads * t * t * (d // heads)
+        head = 6.0 * n_tok * d * v
+        flops = trunk + attn + head
+    else:
+        flops = step_flops(trainer, host_batches[0])
+        if flops is None:
+            flops = ANALYTIC_FLOPS.get(model_name, 0.0) * bs
     peak = chip_peak_flops()
 
     if feed_mode == "placed":
@@ -220,7 +234,47 @@ def main():
         out["gflops_per_step"] = round(flops / 1e9, 1)
         if peak:
             out["mfu"] = round(flops * n / dt / peak, 4)
+    if model_name == "transformer":
+        # self-describing artifact: the config IS the claim at real vocab
+        out["config"] = {
+            "seq_len": model.config["seq_len"], "dim": model.config["dim"],
+            "n_layers": model.config["n_layers"], "vocab": model.data.vocab,
+            "fused_loss": model.fused_loss_enabled(),
+            "flops_accounting": "strict analytic 3x-forward (no remat credit)",
+        }
+    return out
+
+
+def main():
+    model_name = os.environ.get("BENCH_MODEL", "resnet50")
+    out = run_bench(model_name)
+    # the driver contract is ONE JSON line on stdout (the primary model);
+    # the transformer's line goes to a sibling artifact so every round
+    # records the LM number at the real config too (VERDICT r3 #3).  The
+    # side-bench only fires on the default invocation (no BENCH_MODEL):
+    # explicit sweeps shouldn't re-bench the LM per model, and their env
+    # overrides (BENCH_BS/BENCH_FUSED_LOSS/...) would measure an off-label
+    # config, so those knobs are scrubbed for the side run.
     print(json.dumps(out))
+    if "BENCH_MODEL" in os.environ or os.environ.get("BENCH_SKIP_EXTRA"):
+        return
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_transformer.json")
+    if os.path.exists(path):
+        os.remove(path)  # a stale round's file must not masquerade as new
+    saved = {}
+    for k in ("BENCH_BS", "BENCH_SEQ", "BENCH_VOCAB", "BENCH_FUSED_LOSS",
+              "BENCH_STEPS", "BENCH_TRIALS", "BENCH_FEED"):
+        if k in os.environ:
+            saved[k] = os.environ.pop(k)
+    try:
+        extra = run_bench("transformer")
+        with open(path, "w") as f:
+            json.dump(extra, f, indent=1)
+    except Exception as e:  # the primary line must survive regardless
+        print(f"transformer side-bench failed: {e}", file=sys.stderr)
+    finally:
+        os.environ.update(saved)
 
 
 if __name__ == "__main__":

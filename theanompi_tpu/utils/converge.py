@@ -307,7 +307,17 @@ def main(argv=None):
                                n_epochs=args.dcgan_epochs))
     art = {"devices": args.devices, "results": rows,
            "passed": all(r["passed"] for r in rows),
-           "excluded": EXCLUDED}
+           "excluded": EXCLUDED,
+           # scope notes: what a row does and does NOT establish
+           "notes": {
+               "googlenet_bn": (
+                   "the convergence row runs the bn=True, aux=False "
+                   "configuration; the aux-classifier training path is "
+                   "covered by gradient-flow tests "
+                   "(tests/test_zoo.py::test_googlenet_aux_heads), not by "
+                   "a convergence run"
+               ),
+           }}
     with open(args.out, "w") as f:
         json.dump(art, f, indent=1)
     print(json.dumps({"passed": art["passed"], "out": args.out}))
