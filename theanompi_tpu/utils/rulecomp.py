@@ -215,13 +215,20 @@ def _diagnose(results: list[dict]) -> list[str]:
         if e["reached"]:
             ov = e.get("rule_overrides", {})
             alpha = ov.get("alpha")
-            why = ("the r3 failure was the pinned alpha, not tau"
-                   if alpha is not None and alpha != 0.1125 else
-                   "reached at the previously-pinned alpha — lr/grid "
-                   "sensitivity rather than alpha")
+            if ov.get("scale_lr") is False:
+                why = ("the reference scale_lr hook was the confound — "
+                       "tau>1 needs the UNSCALED base lr (the r3 sweep "
+                       "varied base lr with the n_workers-x hook always "
+                       "on, so every setting trained too hot)")
+            elif alpha is not None and alpha != 0.1125:
+                why = "the r3 failure was the pinned alpha, not tau"
+            else:
+                why = ("reached at the previously-pinned alpha — lr/grid "
+                       "sensitivity rather than alpha")
             out.append(
                 f"easgd_tau{tau}: reaches the target at base_lr="
-                f"{e['base_lr']}, alpha={alpha if alpha is not None else 'default'} "
+                f"{e['base_lr']}, alpha={alpha if alpha is not None else 'default'}, "
+                f"overrides={ov} "
                 f"(epochs_to_target={e['epochs_to_target']}) — {why}"
             )
         elif c["reached"]:
