@@ -127,6 +127,10 @@ def build_trainer(model_name: str, platform: str):
 
         bs = int(bs_env) if bs_env else (256 if platform == "tpu" else 64)
         cfg = {"batch_size": bs, "n_train": max(1024, bs * 4), "n_val": bs}
+    if os.environ.get("BENCH_NSUBB"):
+        # gradient accumulation: n_subb micro-batches per step (activation
+        # memory per micro-batch — the large-effective-batch lever)
+        cfg["n_subb"] = int(os.environ["BENCH_NSUBB"])
     model = cls(cfg)
     mesh = make_mesh(n_data=1, devices=jax.devices()[:1])
     # huge print_freq: train_iter fences on metrics at print boundaries,
@@ -194,6 +198,12 @@ def run_bench(model_name: str) -> dict:
         flops = step_flops(trainer, host_batches[0])
         if flops is None:
             flops = ANALYTIC_FLOPS.get(model_name, 0.0) * bs
+        elif int(model.config.get("n_subb", 1) or 1) > 1:
+            # cost analysis counts a lax.scan body ONCE; with gradient
+            # accumulation nearly the whole step lives inside the
+            # micro-batch scan, so scale by n_subb (exchange/update
+            # outside the scan are a rounding error next to fwd+bwd)
+            flops *= int(model.config["n_subb"])
     peak = chip_peak_flops()
 
     if feed_mode == "placed":

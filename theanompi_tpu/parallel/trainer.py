@@ -344,6 +344,14 @@ class BaseTrainer:
             if vbatch is not None:
                 self.val_iter(vbatch)
         self.init_state()
+        self.reset_iter()
+
+    def reset_iter(self) -> None:
+        """Zero the iteration/epoch counters and start a fresh recorder
+        (reference contract name — its ``reset_iter(mode)`` re-armed the
+        per-mode iteration state between phases; here counters live on the
+        trainer and the compiled fns are mode-less pure functions, so a
+        reset is just counters + recorder)."""
         self.iteration = 0
         self.epoch = 0
         self.recorder = Recorder(

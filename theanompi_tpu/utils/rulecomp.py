@@ -214,21 +214,30 @@ def _diagnose(results: list[dict]) -> list[str]:
             continue
         if e["reached"]:
             ov = e.get("rule_overrides", {})
-            alpha = ov.get("alpha")
-            if ov.get("scale_lr") is False:
+            # the exclusive "hook was the confound" claim requires that NO
+            # hook-on arm reached, not just that the best arm is hook-off
+            hook_on_reached = any(
+                s["reached"] and s.get("rule_overrides", {}).get(
+                    "scale_lr", True) is not False
+                for s in e.get("sweep", [e])
+            )
+            if ov.get("scale_lr") is False and not hook_on_reached:
                 why = ("the reference scale_lr hook was the confound — "
                        "tau>1 needs the UNSCALED base lr (the r3 sweep "
                        "varied base lr with the n_workers-x hook always "
                        "on, so every setting trained too hot)")
-            elif alpha is not None and alpha != 0.1125:
+            elif ov.get("scale_lr") is False:
+                why = ("best at the unscaled lr, though a scale_lr-on arm "
+                       "also reached — the hook hurts but is not the sole "
+                       "factor")
+            elif ov.get("alpha") is not None and ov["alpha"] != 0.1125:
                 why = "the r3 failure was the pinned alpha, not tau"
             else:
                 why = ("reached at the previously-pinned alpha — lr/grid "
                        "sensitivity rather than alpha")
             out.append(
                 f"easgd_tau{tau}: reaches the target at base_lr="
-                f"{e['base_lr']}, alpha={alpha if alpha is not None else 'default'}, "
-                f"overrides={ov} "
+                f"{e['base_lr']}, overrides={ov} "
                 f"(epochs_to_target={e['epochs_to_target']}) — {why}"
             )
         elif c["reached"]:
