@@ -117,9 +117,16 @@ def build_trainer(model_name: str, platform: str):
         # host setup stays cheap.
         vocab = int(os.environ.get(
             "BENCH_VOCAB", "32768" if platform == "tpu" else "2048"))
+        dim = int(os.environ.get("BENCH_DIM", "512"))
+        layers = int(os.environ.get("BENCH_LAYERS", "8"))
+        heads = max(8, dim // 64)
+        if dim % heads:
+            raise SystemExit(
+                f"BENCH_DIM={dim} not divisible by derived heads={heads}; "
+                f"use a multiple of 64")
         cfg = {"batch_size": bs, "seq_len": seq, "vocab": vocab,
-               "dim": 512, "heads": 8, "n_layers": 8, "dropout": 0.0,
-               "n_train": bs * 8, "n_val": bs * 2}
+               "dim": dim, "heads": heads, "n_layers": layers,
+               "dropout": 0.0, "n_train": bs * 8, "n_val": bs * 2}
         if "BENCH_FUSED_LOSS" in os.environ:
             cfg["fused_loss"] = bool(int(os.environ["BENCH_FUSED_LOSS"]))
     else:
