@@ -119,11 +119,11 @@ def build_trainer(model_name: str, platform: str):
             "BENCH_VOCAB", "32768" if platform == "tpu" else "2048"))
         dim = int(os.environ.get("BENCH_DIM", "512"))
         layers = int(os.environ.get("BENCH_LAYERS", "8"))
+        # dim a multiple of 64 ⇒ the derived head count divides dim AND
+        # head_dim stays lane-aligned for the pallas kernels
+        if dim % 64:
+            raise SystemExit(f"BENCH_DIM={dim} must be a multiple of 64")
         heads = max(8, dim // 64)
-        if dim % heads:
-            raise SystemExit(
-                f"BENCH_DIM={dim} not divisible by derived heads={heads}; "
-                f"use a multiple of 64")
         cfg = {"batch_size": bs, "seq_len": seq, "vocab": vocab,
                "dim": dim, "heads": heads, "n_layers": layers,
                "dropout": 0.0, "n_train": bs * 8, "n_val": bs * 2}

@@ -66,7 +66,8 @@ def build_parser() -> argparse.ArgumentParser:
         description="Launch a theanompi_tpu training session on the local "
         "mesh (run on every host of a pod for multi-host).",
     )
-    p.add_argument("--rule", default="BSP", choices=["BSP", "EASGD", "GOSGD"])
+    p.add_argument("--rule", default="BSP",
+                   choices=["BSP", "EASGD", "GOSGD", "LocalSGD"])
     p.add_argument("--devices", default="all",
                    help="worker count or 'all' (default)")
     p.add_argument("--modelfile", default="theanompi_tpu.models.wide_resnet")
