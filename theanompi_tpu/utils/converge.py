@@ -214,6 +214,96 @@ def _sliced_wasserstein(a: np.ndarray, b: np.ndarray, n_proj: int = 64,
     return float(np.mean(np.abs(pa - pb)))
 
 
+def _gan_eval_stats(model, trainer, z_dim: int):
+    """Shared GAN measurement block: -> (fake, real, raw critic/disc
+    scores, std_ratio, swd_fake_real, swd_real_real).
+
+    Invariants both GAN rows rely on: the 64-sample fake set comes from a
+    FIXED key (comparable across runs), and both SWD statistics use the
+    SAME sample size (32 vs 32) — finite-sample SWD shrinks with n, so
+    mismatched sizes would silently loosen the gate.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    params = trainer.params
+    cast = model.precision.cast_to_compute
+    z = jax.random.normal(jax.random.PRNGKey(7), (64, z_dim), jnp.float32)
+    fake, _ = model._sample(cast(params["gen"]), trainer.state["gen"], z,
+                            train=False)
+    fake = np.asarray(fake, np.float32)
+    real = next(iter(model.data.val_batches(64)))["x"].astype(np.float32)
+    s_real, _ = model.disc.apply(cast(params["disc"]), trainer.state["disc"],
+                                 jnp.asarray(real))
+    s_fake, _ = model.disc.apply(cast(params["disc"]), trainer.state["disc"],
+                                 jnp.asarray(fake))
+    sample_std = float(np.mean(fake.std(axis=0)))
+    real_std = float(np.mean(real.std(axis=0)))
+    std_ratio = sample_std / max(real_std, 1e-6)
+    swd_fr = _sliced_wasserstein(fake[::2], real[::2])
+    swd_rr = _sliced_wasserstein(real[::2], real[1::2])
+    return (fake, real, np.asarray(s_real, np.float32),
+            np.asarray(s_fake, np.float32), sample_std, real_std,
+            std_ratio, swd_fr, swd_rr)
+
+
+def converge_wgan(devices=8, n_epochs=20, verbose=True) -> dict:
+    """WGAN health row (reference config 5 lists BOTH GAN variants).
+
+    WGAN's critic is trained toward the Wasserstein distance, so the
+    natural health signals differ from DCGAN's: the critic's real-fake
+    score gap IS the W-distance estimate (small and shrinking = G close;
+    large = G lost), and weight clipping keeps scores bounded, so no
+    sigmoid saturation gate applies.  Probed at this scale (matched
+    64/64 nets, RMSProp/paper lr, n_critic=5, 20 epochs): std_ratio
+    0.54, critic gap 0.05 — healthier than the DCGAN setting, no TTUR
+    needed (the n_critic schedule is WGAN's own balancing mechanism).
+    Gates: std_ratio > 0.33 (collapse), |critic_gap| < 1.0 (G lost —
+    clipped-critic scores live in single digits), and the same
+    split-half-calibrated sliced-Wasserstein gate as DCGAN.
+    """
+    from theanompi_tpu.models.dcgan import WGAN
+    from theanompi_tpu.parallel.bsp import BSPTrainer
+    from theanompi_tpu.parallel.mesh import make_mesh
+    from theanompi_tpu.utils.recorder import Recorder
+
+    cfg = {"batch_size": 8, "image_size": 32, "gen_base": 64, "disc_base": 64,
+           "z_dim": 32, "n_train": 256, "n_val": 64, "n_epochs": n_epochs,
+           "precision": "fp32", "verbose": False}
+    model = WGAN(cfg)
+    # print_freq=8: curves only fill at print boundaries (same invariant
+    # as the DCGAN row — a huge print_freq would leave them EMPTY)
+    trainer = BSPTrainer(model, mesh=make_mesh(n_data=devices),
+                         recorder=Recorder(verbose=False, print_freq=8))
+    rec = trainer.run()
+
+    (fake, real, s_real, s_fake, sample_std, real_std, std_ratio,
+     swd_fr, swd_rr) = _gan_eval_stats(model, trainer, cfg["z_dim"])
+    critic_gap = float(np.mean(s_real) - np.mean(s_fake))
+    row = {
+        "model": "wgan_matched",
+        "epochs": n_epochs,
+        "n_critic": model.config["n_critic"],
+        "d_loss_curve": [round(float(v), 4)
+                         for v in rec.train_history.get("d_loss", [])][-50:],
+        "g_loss_curve": [round(float(v), 4)
+                         for v in rec.train_history.get("g_loss", [])][-50:],
+        "sample_std": round(sample_std, 4),
+        "real_std": round(real_std, 4),
+        "std_ratio": round(std_ratio, 4),
+        "critic_gap": round(critic_gap, 4),
+        "swd_fake_real": round(swd_fr, 4),
+        "swd_real_real": round(swd_rr, 4),
+        "passed": bool(std_ratio > 0.33 and abs(critic_gap) < 1.0
+                       and swd_fr < 4.0 * swd_rr),
+    }
+    if verbose:
+        print(json.dumps({k: row[k] for k in
+                          ("model", "passed", "std_ratio", "critic_gap",
+                           "swd_fake_real", "swd_real_real")}), flush=True)
+    return row
+
+
 def converge_dcgan(devices=8, n_epochs=15, verbose=True) -> dict:
     """Train DCGAN with a MATCHED discriminator; -> curves + proxy row.
 
@@ -237,9 +327,6 @@ def converge_dcgan(devices=8, n_epochs=15, verbose=True) -> dict:
       generated set against the real set, calibrated by how far apart two
       real halves sit.
     """
-    import jax
-    import jax.numpy as jnp
-
     from theanompi_tpu.models.dcgan import DCGAN
     from theanompi_tpu.parallel.bsp import BSPTrainer
     from theanompi_tpu.parallel.mesh import make_mesh
@@ -257,31 +344,13 @@ def converge_dcgan(devices=8, n_epochs=15, verbose=True) -> dict:
                          recorder=Recorder(verbose=False, print_freq=8))
     rec = trainer.run()
 
-    params = trainer.params
-    cast = model.precision.cast_to_compute
-    z = jax.random.normal(jax.random.PRNGKey(7), (64, cfg["z_dim"]),
-                          jnp.float32)
-    fake, _ = model._sample(cast(params["gen"]), trainer.state["gen"], z,
-                            train=False)
-    fake = np.asarray(fake, np.float32)
-    sample_std = float(np.mean(fake.std(axis=0)))
+    (fake, real, s_real, s_fake, sample_std, real_std, std_ratio,
+     swd_fr, swd_rr) = _gan_eval_stats(model, trainer, cfg["z_dim"])
 
-    real = next(iter(model.data.val_batches(64)))["x"].astype(np.float32)
-    real_std = float(np.mean(real.std(axis=0)))
-    s_real, _ = model.disc.apply(cast(params["disc"]), trainer.state["disc"],
-                                 jnp.asarray(real))
-    s_fake, _ = model.disc.apply(cast(params["disc"]), trainer.state["disc"],
-                                 jnp.asarray(fake))
     def sigmoid(a):
-        return 1.0 / (1.0 + np.exp(-np.asarray(a, np.float32)))
+        return 1.0 / (1.0 + np.exp(-a))
 
     gap = float(abs(np.mean(sigmoid(s_real)) - np.mean(sigmoid(s_fake))))
-    std_ratio = sample_std / max(real_std, 1e-6)
-    # both statistics at the SAME sample size (32 vs 32): finite-sample
-    # SWD shrinks with n, so a 64-vs-64 fake/real distance against a
-    # 32-vs-32 baseline would make the gate silently looser
-    swd_fr = _sliced_wasserstein(fake[::2], real[::2])
-    swd_rr = _sliced_wasserstein(real[::2], real[1::2])
     row = {
         "model": "dcgan_matched",
         "epochs": n_epochs,
@@ -316,6 +385,7 @@ def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--devices", type=int, default=8)
     p.add_argument("--dcgan-epochs", type=int, default=15)
+    p.add_argument("--wgan-epochs", type=int, default=20)
     p.add_argument("--out", default="CONVERGE.json")
     p.add_argument("--force-host-devices", type=int, default=None)
     args = p.parse_args(argv)
@@ -327,6 +397,8 @@ def main(argv=None):
     rows += converge_sequence_models(devices=args.devices)
     rows.append(converge_dcgan(devices=args.devices,
                                n_epochs=args.dcgan_epochs))
+    rows.append(converge_wgan(devices=args.devices,
+                              n_epochs=args.wgan_epochs))
     art = {"devices": args.devices, "results": rows,
            "passed": all(r["passed"] for r in rows),
            "excluded": EXCLUDED,
