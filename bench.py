@@ -277,21 +277,31 @@ def main():
         return
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_transformer.json")
-    if os.path.exists(path):
-        os.remove(path)  # a stale round's file must not masquerade as new
     saved = {}
     for k in ("BENCH_BS", "BENCH_SEQ", "BENCH_VOCAB", "BENCH_FUSED_LOSS",
-              "BENCH_STEPS", "BENCH_TRIALS", "BENCH_FEED"):
+              "BENCH_STEPS", "BENCH_TRIALS", "BENCH_FEED",
+              "BENCH_DIM", "BENCH_LAYERS", "BENCH_NSUBB"):
         if k in os.environ:
             saved[k] = os.environ.pop(k)
+    done = False
     try:
         extra = run_bench("transformer")
-        with open(path, "w") as f:
+        # atomic publish: success replaces the old artifact; any abort
+        # or failure DELETES it below so a stale round's file can't
+        # masquerade as new; only a hard kill (SIGKILL) leaves the
+        # previous file intact
+        with open(path + ".tmp", "w") as f:
             json.dump(extra, f, indent=1)
+        os.replace(path + ".tmp", path)
+        done = True
     except Exception as e:  # the primary line must survive regardless
         print(f"transformer side-bench failed: {e}", file=sys.stderr)
     finally:
         os.environ.update(saved)
+        if not done:  # covers KeyboardInterrupt/SystemExit too
+            for p in (path, path + ".tmp"):
+                if os.path.exists(p):
+                    os.remove(p)
 
 
 if __name__ == "__main__":

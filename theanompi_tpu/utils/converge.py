@@ -242,9 +242,8 @@ def _gan_eval_stats(model, trainer, z_dim: int):
     std_ratio = sample_std / max(real_std, 1e-6)
     swd_fr = _sliced_wasserstein(fake[::2], real[::2])
     swd_rr = _sliced_wasserstein(real[::2], real[1::2])
-    return (fake, real, np.asarray(s_real, np.float32),
-            np.asarray(s_fake, np.float32), sample_std, real_std,
-            std_ratio, swd_fr, swd_rr)
+    return (np.asarray(s_real, np.float32), np.asarray(s_fake, np.float32),
+            sample_std, real_std, std_ratio, swd_fr, swd_rr)
 
 
 def converge_wgan(devices=8, n_epochs=20, verbose=True) -> dict:
@@ -277,7 +276,7 @@ def converge_wgan(devices=8, n_epochs=20, verbose=True) -> dict:
                          recorder=Recorder(verbose=False, print_freq=8))
     rec = trainer.run()
 
-    (fake, real, s_real, s_fake, sample_std, real_std, std_ratio,
+    (s_real, s_fake, sample_std, real_std, std_ratio,
      swd_fr, swd_rr) = _gan_eval_stats(model, trainer, cfg["z_dim"])
     critic_gap = float(np.mean(s_real) - np.mean(s_fake))
     row = {
@@ -344,7 +343,7 @@ def converge_dcgan(devices=8, n_epochs=15, verbose=True) -> dict:
                          recorder=Recorder(verbose=False, print_freq=8))
     rec = trainer.run()
 
-    (fake, real, s_real, s_fake, sample_std, real_std, std_ratio,
+    (s_real, s_fake, sample_std, real_std, std_ratio,
      swd_fr, swd_rr) = _gan_eval_stats(model, trainer, cfg["z_dim"])
 
     def sigmoid(a):
