@@ -38,6 +38,8 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
+import traceback
 
 import jax
 import jax.numpy as jnp
@@ -119,11 +121,14 @@ def build_trainer(model_name: str, platform: str):
             "BENCH_VOCAB", "32768" if platform == "tpu" else "2048"))
         dim = int(os.environ.get("BENCH_DIM", "512"))
         layers = int(os.environ.get("BENCH_LAYERS", "8"))
-        # dim a multiple of 64 ⇒ the derived head count divides dim AND
-        # head_dim stays lane-aligned for the pallas kernels
-        if dim % 64:
-            raise SystemExit(f"BENCH_DIM={dim} must be a multiple of 64")
-        heads = max(8, dim // 64)
+        # heads = dim/64 ⇒ head_dim is exactly 64, lane-aligned for the
+        # pallas kernels at every ladder rung.  dim < 512 would need a
+        # clamped head count whose head_dim (< 64) silently falls off the
+        # flash path — refuse instead of mismeasuring (ADVICE r4).
+        if dim % 64 or dim < 512:
+            raise SystemExit(
+                f"BENCH_DIM={dim} must be a multiple of 64 and >= 512")
+        heads = dim // 64
         cfg = {"batch_size": bs, "seq_len": seq, "vocab": vocab,
                "dim": dim, "heads": heads, "n_layers": layers,
                "dropout": 0.0, "n_train": bs * 8, "n_val": bs * 2}
@@ -252,19 +257,35 @@ def run_bench(model_name: str) -> dict:
         if peak:
             out["mfu"] = round(flops * n / dt / peak, 4)
     if model_name == "transformer":
+        from theanompi_tpu.ops.attention import resolve_attn_impl
+
+        # the model's own resolver, so the artifact records which attention
+        # path actually ran (ADVICE r4: a shape falling off the flash path
+        # must be visible, not silent)
+        impl = resolve_attn_impl(
+            model.config["attn_impl"], model.config["seq_len"],
+            model.config["dim"] // model.config["heads"])
         # self-describing artifact: the config IS the claim at real vocab
         out["config"] = {
             "seq_len": model.config["seq_len"], "dim": model.config["dim"],
             "n_layers": model.config["n_layers"], "vocab": model.data.vocab,
             "fused_loss": model.fused_loss_enabled(),
+            "attention_impl": impl,
             "flops_accounting": "strict analytic 3x-forward (no remat credit)",
         }
     return out
 
 
-def main():
+def _measure():
+    """One full measurement pass: primary line + transformer side artifact."""
     model_name = os.environ.get("BENCH_MODEL", "resnet50")
+    # run id stamped onto every artifact this process emits: a stale side
+    # artifact surviving a failed later run is detectable by its id not
+    # matching the round's BENCH_r* capture (VERDICT r4 #1 — in round 4 a
+    # 10:24 side file outlived an 11:11 crashed driver run, undetectably)
+    run_id = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()) + f"-p{os.getpid()}"
     out = run_bench(model_name)
+    out["run_id"] = run_id
     # the driver contract is ONE JSON line on stdout (the primary model);
     # the transformer's line goes to a sibling artifact so every round
     # records the LM number at the real config too (VERDICT r3 #3).  The
@@ -283,25 +304,127 @@ def main():
               "BENCH_DIM", "BENCH_LAYERS", "BENCH_NSUBB"):
         if k in os.environ:
             saved[k] = os.environ.pop(k)
-    done = False
     try:
         extra = run_bench("transformer")
-        # atomic publish: success replaces the old artifact; any abort
-        # or failure DELETES it below so a stale round's file can't
-        # masquerade as new; only a hard kill (SIGKILL) leaves the
-        # previous file intact
+        extra["run_id"] = run_id
+        # atomic publish: only success replaces the old artifact.  On any
+        # failure the previous file stays in place — deleting it would
+        # erase the last good measurement on a transient failure (ADVICE
+        # r4), and the run_id stamp already makes staleness detectable.
         with open(path + ".tmp", "w") as f:
             json.dump(extra, f, indent=1)
         os.replace(path + ".tmp", path)
-        done = True
     except Exception as e:  # the primary line must survive regardless
         print(f"transformer side-bench failed: {e}", file=sys.stderr)
     finally:
         os.environ.update(saved)
-        if not done:  # covers KeyboardInterrupt/SystemExit too
-            for p in (path, path + ".tmp"):
-                if os.path.exists(p):
-                    os.remove(p)
+        try:
+            os.remove(path + ".tmp")
+        except OSError:  # no leftover, or something unremovable — not worth
+            pass         # failing the primary line over
+
+
+def _transient(e: BaseException) -> bool:
+    """Does this failure look like a backend/tunnel outage worth a re-exec?
+
+    Deterministic errors (a bad BENCH_* combination, a model bug) must NOT
+    burn 5 attempts x 60 s on the shared chip; only infrastructure-shaped
+    failures retry.  The match is on type name + message because jaxlib's
+    XlaRuntimeError class path varies across versions.
+    """
+    name = type(e).__name__
+    msg = str(e)
+    return ("XlaRuntimeError" in name
+            or "backend init still blocked" in msg
+            or "UNAVAILABLE" in msg
+            or "DEADLINE_EXCEEDED" in msg
+            or "backend setup" in msg
+            or "Connection" in msg
+            or "socket" in msg.lower())
+
+
+def _acquire_backend(timeout_s: float):
+    """``jax.devices()`` behind a watchdog thread.
+
+    A downed tunnel does not always raise: measured on this image, backend
+    init can BLOCK for >10 minutes inside the PJRT client instead of
+    failing (the r4 driver loss was the raising variant; this is the other
+    one).  A hung init cannot be cancelled in-process, so on timeout we
+    raise — and the retry path re-execs the whole process, hung thread and
+    all.
+    """
+    import threading
+
+    out = {}
+
+    def probe():
+        try:
+            out["devices"] = jax.devices()
+        except Exception as e:  # re-raised on the main thread below
+            out["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise RuntimeError(
+            f"backend init still blocked after {timeout_s:.0f}s")
+    if "error" in out:
+        raise out["error"]
+    return out["devices"]
+
+
+def main():
+    """Run ``_measure`` with a bounded process-level retry.
+
+    Round 4's driver bench died on the first ``jax.devices()`` call with a
+    transient ``UNAVAILABLE: TPU backend setup/compile error`` (the shared
+    tunnel was down for a moment) and the round lost its headline perf
+    artifact (VERDICT r4 #1).  jax caches a *failed* backend init for the
+    life of the process, so an in-process retry would re-raise the cached
+    error; instead each retry re-execs this script — a fresh process, a
+    fresh PJRT client, a fresh tunnel connection.  The attempt count and a
+    one-line-per-attempt log thread through the environment and the final
+    failure re-raises with that log in the error tail.
+
+    Knobs: BENCH_INIT_RETRIES (default 5 attempts), BENCH_RETRY_BACKOFF
+    (default 60 s between attempts), BENCH_INIT_TIMEOUT (default 300 s —
+    see ``_acquire_backend``), BENCH_PLATFORM (force a jax platform at the
+    config level: this image's sitecustomize imports jax with the tunnel
+    platform baked into config defaults, so the plain JAX_PLATFORMS env
+    var is too late to stop a downed-tunnel init from blocking).
+    BENCH_FAIL_UNTIL_ATTEMPT=N is fault injection for the retry-path
+    test: attempts < N raise a simulated UNAVAILABLE before touching the
+    backend.
+    """
+    attempt = int(os.environ.get("BENCH_ATTEMPT", "1"))
+    retries = int(os.environ.get("BENCH_INIT_RETRIES", "5"))
+    backoff = float(os.environ.get("BENCH_RETRY_BACKOFF", "60"))
+    try:
+        if attempt < int(os.environ.get("BENCH_FAIL_UNTIL_ATTEMPT", "0")):
+            raise RuntimeError("UNAVAILABLE: injected backend failure"
+                               " (BENCH_FAIL_UNTIL_ATTEMPT)")
+        if os.environ.get("BENCH_PLATFORM"):
+            jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+        _acquire_backend(float(os.environ.get("BENCH_INIT_TIMEOUT", "300")))
+        _measure()
+    except Exception as e:
+        line = f"attempt {attempt}/{retries}: {type(e).__name__}: {str(e)[:300]}"
+        log = os.environ.get("BENCH_ATTEMPT_LOG", "")
+        log = (log + " | " if log else "") + line
+        print(f"bench: {line}", file=sys.stderr)
+        if attempt >= retries or not _transient(e):
+            traceback.print_exc()
+            raise SystemExit(
+                f"bench: giving up after {attempt} attempts"
+                f"{'' if _transient(e) else ' (non-transient error)'};"
+                f" log: {log}")
+        os.environ["BENCH_ATTEMPT"] = str(attempt + 1)
+        os.environ["BENCH_ATTEMPT_LOG"] = log
+        time.sleep(backoff)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.execv(sys.executable, [sys.executable, os.path.abspath(__file__)])
 
 
 if __name__ == "__main__":

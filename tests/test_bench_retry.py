@@ -1,0 +1,67 @@
+"""bench.py retry path: survive transient backend outages (VERDICT r4 #1).
+
+Round 4's driver bench died on one transient ``UNAVAILABLE`` from the
+tunneled TPU backend and the round lost its headline artifact.  bench.py now
+retries by re-exec'ing itself (jax caches a failed backend init for the life
+of the process, so only a fresh process can actually retry).  These tests
+drive that path with the BENCH_FAIL_UNTIL_ATTEMPT fault-injection knob on
+the CPU backend — the reference had no analogue (its launcher just died with
+mpirun, SURVEY.md §4); this is harness hardening our driver contract needs.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _env(**extra):
+    env = dict(os.environ)
+    env.update({
+        # BENCH_PLATFORM, not just JAX_PLATFORMS: sitecustomize bakes the
+        # tunnel platform into jax's config defaults, so only the
+        # config-level force keeps the subprocess off a (possibly downed,
+        # init-blocking) tunnel backend
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_PLATFORM": "cpu",
+        "BENCH_MODEL": "wide_resnet",  # primary only: no side-bench
+        "BENCH_BS": "8",
+        "BENCH_STEPS": "2",
+        "BENCH_TRIALS": "1",
+        "BENCH_RETRY_BACKOFF": "0",
+    })
+    env.update({k: str(v) for k, v in extra.items()})
+    # a stale attempt counter inherited from the runner would skew the test
+    env.pop("BENCH_ATTEMPT", None)
+    env.pop("BENCH_ATTEMPT_LOG", None)
+    return env
+
+
+def test_retry_recovers_after_transient_failures():
+    p = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True, timeout=600,
+        env=_env(BENCH_FAIL_UNTIL_ATTEMPT=3, BENCH_INIT_RETRIES=5),
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [l for l in p.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1, p.stdout  # driver contract: ONE JSON line
+    out = json.loads(lines[0])
+    assert out["value"] > 0
+    assert "run_id" in out  # staleness stamp (VERDICT r4 #1)
+    # both failed attempts left a visible trace
+    assert "attempt 1/5" in p.stderr and "attempt 2/5" in p.stderr
+
+
+def test_retry_gives_up_with_attempt_log_in_error_tail():
+    p = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True, timeout=120,
+        env=_env(BENCH_FAIL_UNTIL_ATTEMPT=99, BENCH_INIT_RETRIES=2),
+    )
+    assert p.returncode != 0
+    assert "giving up after 2 attempts" in p.stderr
+    # the full per-attempt log survives into the terminal error
+    assert "attempt 1/2" in p.stderr and "attempt 2/2" in p.stderr
+    assert p.stdout.strip() == ""  # no half-measured JSON line

@@ -152,3 +152,60 @@ def test_synthetic_sequence_large_vocab_sparse():
         for a, b in zip(row[:-1], row[1:]):
             succ.setdefault(int(a), set()).add(int(b))
     assert max(len(s) for s in succ.values()) <= 32
+
+
+def test_convert_hkl_tree_with_stubbed_hickle(tmp_path, monkeypatch):
+    """The .hkl conversion loop, with ``hickle`` stubbed (VERDICT r4 #5).
+
+    hickle is not installed in this image, so the real format has never
+    been read here (stated in the docstring/README); this covers what CAN
+    be covered without it: lexicographic file ordering, the CHW->HWC
+    transpose branch, uint8 output, and that the output pairs with
+    ``write_shards``-style label files into a loadable ``ImageNetData``.
+    """
+    import sys
+    import types
+
+    from theanompi_tpu.models.data.imagenet import convert_hkl_tree
+
+    rng = np.random.RandomState(0)
+    shards = {}  # abs path -> array the stub returns
+    src = tmp_path / "hkl"
+    src.mkdir()
+    for i in range(3):
+        # reference-era layout: CHW, one shard per file, float-ish storage
+        arr = rng.randint(0, 255, size=(4, 3, 8, 8)).astype(np.float32)
+        p = src / f"train_{i:02d}.hkl"
+        p.write_bytes(b"")  # listdir needs the file to exist
+        shards[str(p)] = arr
+    (src / "ignore.txt").write_text("not a shard")
+
+    stub = types.ModuleType("hickle")
+    stub.load = lambda path: shards[str(path)]
+    monkeypatch.setitem(sys.modules, "hickle", stub)
+
+    dst = tmp_path / "npy" / "train"
+    convert_hkl_tree(str(src), str(dst))
+
+    xs = sorted(os.listdir(dst))
+    assert xs == ["x_0000.npy", "x_0001.npy", "x_0002.npy"]
+    for i, f in enumerate(xs):
+        out = np.load(dst / f)
+        assert out.dtype == np.uint8 and out.shape == (4, 8, 8, 3)  # HWC
+        expect = shards[str(src / f"train_{i:02d}.hkl")]
+        np.testing.assert_array_equal(
+            out, expect.transpose(0, 2, 3, 1).astype(np.uint8))
+        # labels live in sibling .npy files in the reference recipe
+        np.save(dst / f.replace("x_", "y_"),
+                np.arange(4, dtype=np.int32) % 2)
+    # the converted tree is a loadable split for the production loader
+    (tmp_path / "npy" / "val").mkdir()
+    for f in xs:
+        np.save(tmp_path / "npy" / "val" / f, np.load(dst / f))
+        np.save(tmp_path / "npy" / "val" / f.replace("x_", "y_"),
+                np.arange(4, dtype=np.int32) % 2)
+    ds = ImageNetData({"data_path": str(tmp_path / "npy"), "image_size": 8,
+                       "n_classes": 2})
+    assert not ds.synthetic and ds.n_train == 12
+    batch = next(iter(ds.train_batches(4, epoch=0, seed=0)))
+    assert batch["x"].shape == (4, 8, 8, 3) and batch["x"].dtype == np.uint8

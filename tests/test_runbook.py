@@ -1,0 +1,54 @@
+"""CPU-mesh dry-run of the BASELINE.md RUNBOOK commands (VERDICT r4 #8).
+
+The v5e-16 north-star procedure can't execute on this image (one tunneled
+chip), so this locks the *procedure*: the exact CLI entry points and flags
+the RUNBOOK documents must parse, run end-to-end on the virtual mesh at
+tiny scale, and emit artifacts with the fields the RUNBOOK's efficiency
+arithmetic reads.  If a flag or artifact key changes, this breaks before
+the doc rots.
+"""
+
+import json
+import os
+
+from theanompi_tpu import launcher
+from theanompi_tpu.utils import scaling
+
+
+def test_runbook_scaling_command(tmp_path):
+    """RUNBOOK steps 1-3 at toy scale: same flags, tiny steps/batch."""
+    out = str(tmp_path / "SCALING_v5e16_host.json")
+    scaling.main([
+        "--model", "resnet50",
+        "--batch-size", "4", "--ns", "1,2", "--steps", "2", "--trials", "1",
+        "--strategy", "psum_bf16", "--out", out,
+    ])
+    art = json.load(open(out))
+    # the fields step 3's verdict arithmetic reads, per rung (JSON turns
+    # the int keys into strings)
+    for n in ("1", "2"):
+        row = art["per_n"][n]
+        assert row["imgs_per_sec_per_chip"] > 0
+        assert "comm_share" in row and "efficiency" in row
+    eff = (art["per_n"]["2"]["imgs_per_sec_per_chip"]
+           / art["per_n"]["1"]["imgs_per_sec_per_chip"])
+    assert eff > 0  # the cross-artifact ratio the RUNBOOK computes
+
+
+def test_runbook_launcher_command(tmp_path):
+    """RUNBOOK step 4's tmlauncher invocation, shrunk to one tiny epoch."""
+    record = str(tmp_path / "record")
+    rc = launcher.main([
+        "--rule", "BSP", "--devices", "8",
+        "--modelfile", "theanompi_tpu.models.resnet50",
+        "--modelclass", "ResNet50",
+        "--set", "batch_size=2", "--set", "n_epochs=1",
+        "--set", "image_size=32", "--set", "store_size=40",
+        "--set", "n_classes=4", "--set", "n_train=32", "--set", "n_val=16",
+        "--set", "shard_size=16", "--set", "precision=fp32",
+        "--rule-set", "exch_strategy=psum_bf16",
+        "--record-dir", record, "--quiet",
+    ])
+    assert rc == 0
+    # the recorder histories the RUNBOOK points at
+    assert any(f.endswith(".npy") for f in os.listdir(record))

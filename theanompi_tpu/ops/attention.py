@@ -27,6 +27,27 @@ from theanompi_tpu.parallel.tensor import (
 )
 
 
+def resolve_attn_impl(impl: str, t: int, head_dim: int) -> str:
+    """The concrete path ``MultiHeadAttention.apply`` takes for an
+    UNSHARDED seq axis: ``'pallas'`` or ``'blockwise'``.
+
+    ``'auto'`` = pallas flash kernels on TPU when the shape gate admits
+    them (elsewhere interpret mode would be pure slowdown).  Shared with
+    bench.py's artifact reporting so the recorded ``attention_impl`` can't
+    drift from the gate the model actually applies (code-review r5).
+    """
+    if impl == "auto":
+        from theanompi_tpu.ops.pallas_attention import (
+            flash_attention_supported,
+        )
+
+        return ("pallas"
+                if jax.default_backend() == "tpu"
+                and flash_attention_supported(t, head_dim)
+                else "blockwise")
+    return impl
+
+
 @dataclasses.dataclass(frozen=True)
 class MultiHeadAttention(L.Layer):
     """Causal/bidirectional MHA over ``[B, T, D]``.
@@ -110,18 +131,9 @@ class MultiHeadAttention(L.Layer):
         if axis_bound(SEQ_AXIS) and jax.lax.axis_size(SEQ_AXIS) > 1:
             out = ring_attention(q, k, v, causal=self.causal)
         else:
-            from theanompi_tpu.ops.pallas_attention import (
-                flash_attention,
-                flash_attention_supported,
-            )
+            from theanompi_tpu.ops.pallas_attention import flash_attention
 
-            use_pallas = self.impl == "pallas" or (
-                self.impl == "auto"
-                and jax.default_backend() == "tpu"  # win measured on TPU;
-                # elsewhere interpret mode would be pure slowdown
-                and flash_attention_supported(t, head_dim)
-            )
-            if use_pallas:
+            if resolve_attn_impl(self.impl, t, head_dim) == "pallas":
                 out = flash_attention(q, k, v, causal=self.causal)
             else:
                 out = blockwise_attention(q, k, v, causal=self.causal)

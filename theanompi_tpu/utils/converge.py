@@ -85,6 +85,20 @@ CLASSIFIER_RUNS = [
         0.35, 20,
     ),
     (
+        # the trained-as-shipped configuration (VERDICT r4 #6): the
+        # reference trained GoogLeNet WITH its aux classifiers (SURVEY.md
+        # §2.1), so the bn-only row proves a different network than the
+        # paper's; this row turns both knobs on (aux losses join the train
+        # cost at the paper's 0.3 weight, googlenet.py:285)
+        "googlenet_bn_aux",
+        "theanompi_tpu.models.googlenet", "GoogLeNet",
+        {"image_size": 64, "store_size": 72, "n_classes": 10,
+         "batch_size": 16, "n_train": 512, "n_val": 128, "shard_size": 128,
+         "bn": True, "aux": True, "dropout": 0.2, "lr": 0.01,
+         "lr_decay_epochs": (), "weight_decay": 0.0, "precision": "fp32"},
+        0.35, 20,
+    ),
+    (
         "resnet50_easgd_tau4",
         "theanompi_tpu.models.resnet50", "ResNet50",
         {"image_size": 64, "store_size": 72, "n_classes": 10,
@@ -146,28 +160,72 @@ def _bigram_floor_ppl(vocab: int, seed: int = 0) -> float:
     return float(np.exp(h))
 
 
-def converge_sequence_models(devices=8, runs=None, verbose=True) -> list[dict]:
+def converge_sequence_models(devices=8, runs=None, verbose=True,
+                             seeds=(0, 1, 2), overshoot=0.5) -> list[dict]:
+    """LM rows, multi-seed with a margin-forcing stop (VERDICT r4 #4).
+
+    The r4 transformer row passed by 0.008 perplexity — an artifact of
+    stop-at-target: ``run_to_target`` halts the moment the metric crosses
+    the gate, so the recorded best sits epsilon under it no matter how much
+    budget remains.  Each run now trains toward ``target - overshoot``
+    (same epoch budget), which forces the recorded best at least
+    ``overshoot`` below the REAL gate when the model has the capacity —
+    pass/fail and epochs_to_target are still judged against the real
+    target from the curve.  Every row runs ``seeds`` times; the artifact
+    carries per-seed summaries + ``pass_rate`` (curve kept for seed 0).
+    """
     from theanompi_tpu import BSP
     from theanompi_tpu.utils.rulecomp import run_to_target
 
     rows = []
     for name, mf, mc, cfg, target, max_epochs in (runs or SEQUENCE_RUNS):
-        rule = BSP(config={"seed": 0, "verbose": False})
-        row = run_to_target(
-            rule, devices=devices, model_config=dict(cfg),
-            target_error=target, max_epochs=max_epochs,
-            modelfile=mf, modelclass=mc, metric="perplexity",
-        )
+        per_seed = []
+        first = None
+        for s in seeds:
+            rule = BSP(config={"seed": s, "verbose": False})
+            r = run_to_target(
+                rule, devices=devices, model_config=dict(cfg),
+                target_error=target - overshoot, max_epochs=max_epochs,
+                modelfile=mf, modelclass=mc, metric="perplexity",
+            )
+            curve = r["val_perplexity_curve"]
+            hits = [i for i, v in enumerate(curve) if v <= target]
+            best = r["best_val_perplexity"]
+            per_seed.append({
+                "seed": s,
+                "passed": bool(hits),
+                "epochs_to_target": hits[0] if hits else None,
+                "best_val_perplexity": best,
+                "margin": (round(target - best, 4)
+                           if best is not None else None),
+            })
+            if first is None:
+                first = r
         row = {"model": name, "target_perplexity": target,
+               "stop_target_perplexity": target - overshoot,
                "entropy_floor_perplexity":
                    round(_bigram_floor_ppl(cfg["vocab"]), 2),
-               "passed": row["reached"], **row}
+               "passed": all(p["passed"] for p in per_seed),
+               **first}
+        # run_to_target's reached/epochs/steps fields refer to the
+        # overshoot stop — rename them so the row can't carry two fields
+        # silently keyed to different targets; the row-level verdict and
+        # epochs_to_target are against the real gate
+        row["reached_stop_target"] = row.pop("reached")
+        row["epochs_to_stop_target"] = row.pop("epochs_to_target")
+        row["steps_to_stop_target"] = row.pop("steps_to_target")
+        row["epochs_to_target"] = per_seed[0]["epochs_to_target"]
+        row["seeds"] = per_seed
+        row["pass_rate"] = round(
+            sum(p["passed"] for p in per_seed) / len(per_seed), 3)
         rows.append(row)
         if verbose:
-            print(json.dumps({k: row[k] for k in
-                              ("model", "passed", "epochs_to_target",
-                               "best_val_error",
-                               "entropy_floor_perplexity")}), flush=True)
+            print(json.dumps({
+                "model": name, "passed": row["passed"],
+                "pass_rate": row["pass_rate"],
+                "margins": [p["margin"] for p in per_seed],
+                "entropy_floor_perplexity":
+                    row["entropy_floor_perplexity"]}), flush=True)
     return rows
 
 
@@ -215,8 +273,11 @@ def _sliced_wasserstein(a: np.ndarray, b: np.ndarray, n_proj: int = 64,
 
 
 def _gan_eval_stats(model, trainer, z_dim: int):
-    """Shared GAN measurement block: -> (fake, real, raw critic/disc
-    scores, std_ratio, swd_fake_real, swd_real_real).
+    """Shared GAN measurement block: -> the 7-tuple
+    ``(scores_real, scores_fake, fake_std, real_std, std_ratio,
+    swd_fake_real, swd_real_real)`` — the two leading entries are raw
+    critic/disc score arrays (real first), the rest are scalars; no
+    sample images are returned.
 
     Invariants both GAN rows rely on: the 64-sample fake set comes from a
     FIXED key (comparable across runs), and both SWD statistics use the
@@ -246,7 +307,64 @@ def _gan_eval_stats(model, trainer, z_dim: int):
             sample_std, real_std, std_ratio, swd_fr, swd_rr)
 
 
-def converge_wgan(devices=8, n_epochs=20, verbose=True) -> dict:
+def _gan_multi_seed_row(model_cls, cfg, devices, seeds, judge,
+                        base_row) -> dict:
+    """Shared multi-seed GAN scaffold (code-review r5: the DCGAN and WGAN
+    rows differ only in model class, gap statistic, and pass predicate).
+
+    Trains one run per seed, evaluates via ``_gan_eval_stats``, and gates
+    each with ``judge(s_real, s_fake, std_ratio, swd_fr, swd_rr) ->
+    (gap_key, gap_value, passed)``.  Curves and full stats are kept from
+    the FIRST seed (bounded artifact size); the row carries per-seed
+    summaries, ``pass_rate``, and all-seeds ``passed``.
+    """
+    from theanompi_tpu.parallel.bsp import BSPTrainer
+    from theanompi_tpu.parallel.mesh import make_mesh
+    from theanompi_tpu.utils.recorder import Recorder
+
+    mesh = make_mesh(n_data=devices)
+    row = None
+    per_seed = []
+    for s in seeds:
+        model = model_cls(cfg)
+        # print_freq=8: train_history only fills at print boundaries (the
+        # recorder never records per-iteration to avoid device syncs), so
+        # a huge print_freq would leave the loss curves EMPTY
+        trainer = BSPTrainer(model, mesh=mesh, seed=s,
+                             recorder=Recorder(verbose=False, print_freq=8))
+        rec = trainer.run()
+        (s_real, s_fake, sample_std, real_std, std_ratio,
+         swd_fr, swd_rr) = _gan_eval_stats(model, trainer, cfg["z_dim"])
+        gap_key, gap_val, passed = judge(s_real, s_fake, std_ratio,
+                                         swd_fr, swd_rr)
+        per_seed.append({"seed": s, "passed": passed,
+                         "std_ratio": round(std_ratio, 4),
+                         gap_key: round(gap_val, 4),
+                         "swd_fake_real": round(swd_fr, 4),
+                         "swd_real_real": round(swd_rr, 4)})
+        if row is None:
+            row = {
+                **base_row(model),
+                "d_loss_curve": [round(float(v), 4) for v in
+                                 rec.train_history.get("d_loss", [])][-50:],
+                "g_loss_curve": [round(float(v), 4) for v in
+                                 rec.train_history.get("g_loss", [])][-50:],
+                "sample_std": round(sample_std, 4),
+                "real_std": round(real_std, 4),
+                "std_ratio": round(std_ratio, 4),
+                gap_key: round(gap_val, 4),
+                "swd_fake_real": round(swd_fr, 4),
+                "swd_real_real": round(swd_rr, 4),
+            }
+    row["seeds"] = per_seed
+    row["pass_rate"] = round(sum(p["passed"] for p in per_seed)
+                             / len(per_seed), 3)
+    row["passed"] = all(p["passed"] for p in per_seed)
+    return row
+
+
+def converge_wgan(devices=8, n_epochs=20, verbose=True,
+                  seeds=(0, 1, 2)) -> dict:
     """WGAN health row (reference config 5 lists BOTH GAN variants).
 
     WGAN's critic is trained toward the Wasserstein distance, so the
@@ -262,48 +380,31 @@ def converge_wgan(devices=8, n_epochs=20, verbose=True) -> dict:
     split-half-calibrated sliced-Wasserstein gate as DCGAN.
     """
     from theanompi_tpu.models.dcgan import WGAN
-    from theanompi_tpu.parallel.bsp import BSPTrainer
-    from theanompi_tpu.parallel.mesh import make_mesh
-    from theanompi_tpu.utils.recorder import Recorder
 
     cfg = {"batch_size": 8, "image_size": 32, "gen_base": 64, "disc_base": 64,
            "z_dim": 32, "n_train": 256, "n_val": 64, "n_epochs": n_epochs,
            "precision": "fp32", "verbose": False}
-    model = WGAN(cfg)
-    # print_freq=8: curves only fill at print boundaries (same invariant
-    # as the DCGAN row — a huge print_freq would leave them EMPTY)
-    trainer = BSPTrainer(model, mesh=make_mesh(n_data=devices),
-                         recorder=Recorder(verbose=False, print_freq=8))
-    rec = trainer.run()
 
-    (s_real, s_fake, sample_std, real_std, std_ratio,
-     swd_fr, swd_rr) = _gan_eval_stats(model, trainer, cfg["z_dim"])
-    critic_gap = float(np.mean(s_real) - np.mean(s_fake))
-    row = {
-        "model": "wgan_matched",
-        "epochs": n_epochs,
-        "n_critic": model.config["n_critic"],
-        "d_loss_curve": [round(float(v), 4)
-                         for v in rec.train_history.get("d_loss", [])][-50:],
-        "g_loss_curve": [round(float(v), 4)
-                         for v in rec.train_history.get("g_loss", [])][-50:],
-        "sample_std": round(sample_std, 4),
-        "real_std": round(real_std, 4),
-        "std_ratio": round(std_ratio, 4),
-        "critic_gap": round(critic_gap, 4),
-        "swd_fake_real": round(swd_fr, 4),
-        "swd_real_real": round(swd_rr, 4),
-        "passed": bool(std_ratio > 0.33 and abs(critic_gap) < 1.0
-                       and swd_fr < 4.0 * swd_rr),
-    }
+    def judge(s_real, s_fake, std_ratio, swd_fr, swd_rr):
+        critic_gap = float(np.mean(s_real) - np.mean(s_fake))
+        return "critic_gap", critic_gap, bool(
+            std_ratio > 0.33 and abs(critic_gap) < 1.0
+            and swd_fr < 4.0 * swd_rr)
+
+    row = _gan_multi_seed_row(
+        WGAN, cfg, devices, seeds, judge,
+        lambda model: {"model": "wgan_matched", "epochs": n_epochs,
+                       "n_critic": model.config["n_critic"]})
     if verbose:
         print(json.dumps({k: row[k] for k in
-                          ("model", "passed", "std_ratio", "critic_gap",
-                           "swd_fake_real", "swd_real_real")}), flush=True)
+                          ("model", "passed", "pass_rate", "std_ratio",
+                           "critic_gap", "swd_fake_real", "swd_real_real")}),
+              flush=True)
     return row
 
 
-def converge_dcgan(devices=8, n_epochs=15, verbose=True) -> dict:
+def converge_dcgan(devices=8, n_epochs=15, verbose=True,
+                   seeds=(0, 1, 2)) -> dict:
     """Train DCGAN with a MATCHED discriminator; -> curves + proxy row.
 
     VERDICT r3 #9: the old evidence passed by under-building D
@@ -327,55 +428,33 @@ def converge_dcgan(devices=8, n_epochs=15, verbose=True) -> dict:
       real halves sit.
     """
     from theanompi_tpu.models.dcgan import DCGAN
-    from theanompi_tpu.parallel.bsp import BSPTrainer
-    from theanompi_tpu.parallel.mesh import make_mesh
-    from theanompi_tpu.utils.recorder import Recorder
 
     cfg = {"batch_size": 8, "image_size": 32, "gen_base": 64, "disc_base": 64,
            "z_dim": 32, "n_train": 256, "n_val": 64, "n_epochs": n_epochs,
            "disc_lr_scale": 0.25, "precision": "fp32", "verbose": False}
-    model = DCGAN(cfg)
-    mesh = make_mesh(n_data=devices)
-    # print_freq=8: train_history only fills at print boundaries (the
-    # recorder never records per-iteration to avoid device syncs), so a
-    # huge print_freq would leave the loss curves EMPTY
-    trainer = BSPTrainer(model, mesh=mesh,
-                         recorder=Recorder(verbose=False, print_freq=8))
-    rec = trainer.run()
 
-    (s_real, s_fake, sample_std, real_std, std_ratio,
-     swd_fr, swd_rr) = _gan_eval_stats(model, trainer, cfg["z_dim"])
+    def judge(s_real, s_fake, std_ratio, swd_fr, swd_rr):
+        def sigmoid(a):
+            return 1.0 / (1.0 + np.exp(-a))
 
-    def sigmoid(a):
-        return 1.0 / (1.0 + np.exp(-a))
-
-    gap = float(abs(np.mean(sigmoid(s_real)) - np.mean(sigmoid(s_fake))))
-    row = {
-        "model": "dcgan_matched",
-        "epochs": n_epochs,
-        "gen_base": cfg["gen_base"], "disc_base": cfg["disc_base"],
-        "disc_lr_scale": cfg["disc_lr_scale"],
-        "d_loss_curve": [round(float(v), 4)
-                         for v in rec.train_history.get("d_loss", [])][-50:],
-        "g_loss_curve": [round(float(v), 4)
-                         for v in rec.train_history.get("g_loss", [])][-50:],
-        "sample_std": round(sample_std, 4),
-        "real_std": round(real_std, 4),
-        "std_ratio": round(std_ratio, 4),
-        "disc_gap": round(gap, 4),
-        "swd_fake_real": round(swd_fr, 4),
-        "swd_real_real": round(swd_rr, 4),
+        gap = float(abs(np.mean(sigmoid(s_real)) - np.mean(sigmoid(s_fake))))
         # pass: not collapsed (real-relative), D not saturated, and the
         # generated DISTRIBUTION within 4x the real split-half distance
         # (measured healthy run: 2.4x; collapse blows the sorted-projection
         # gaps up along with the std ratio)
-        "passed": bool(std_ratio > 0.33 and gap < 0.8
-                       and swd_fr < 4.0 * swd_rr),
-    }
+        return "disc_gap", gap, bool(std_ratio > 0.33 and gap < 0.8
+                                     and swd_fr < 4.0 * swd_rr)
+
+    row = _gan_multi_seed_row(
+        DCGAN, cfg, devices, seeds, judge,
+        lambda model: {"model": "dcgan_matched", "epochs": n_epochs,
+                       "gen_base": cfg["gen_base"],
+                       "disc_base": cfg["disc_base"],
+                       "disc_lr_scale": cfg["disc_lr_scale"]})
     if verbose:
         print(json.dumps({k: row[k] for k in
-                          ("model", "passed", "std_ratio", "disc_gap",
-                           "swd_fake_real", "swd_real_real")}),
+                          ("model", "passed", "pass_rate", "std_ratio",
+                           "disc_gap", "swd_fake_real", "swd_real_real")}),
               flush=True)
     return row
 
@@ -404,11 +483,17 @@ def main(argv=None):
            # scope notes: what a row does and does NOT establish
            "notes": {
                "googlenet_bn": (
-                   "the convergence row runs the bn=True, aux=False "
-                   "configuration; the aux-classifier training path is "
-                   "covered by gradient-flow tests "
-                   "(tests/test_zoo.py::test_googlenet_aux_heads), not by "
-                   "a convergence run"
+                   "the bn=True, aux=False configuration; the as-shipped "
+                   "trained configuration (aux classifiers ON, paper "
+                   "weight 0.3) is the separate googlenet_bn_aux row "
+                   "(VERDICT r4 #6)"
+               ),
+               "seeds": (
+                   "LM and GAN rows run 3 seeds with per-seed summaries "
+                   "and pass_rate; LM rows train toward target-0.5 (the "
+                   "stop_target) so the recorded best carries visible "
+                   "margin under the real gate instead of stopping "
+                   "epsilon past it (VERDICT r4 #4)"
                ),
            }}
     with open(args.out, "w") as f:

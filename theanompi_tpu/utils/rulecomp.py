@@ -84,7 +84,7 @@ def run_to_target(rule, *, devices, model_config: dict, target_error: float,
     rec = rule.trainer.run(stop=stop)
     wall = time.perf_counter() - t0
     curve = [float(e) for e in rec.val_history.get(metric, [])]
-    return {
+    row = {
         "reached": "epoch" in hit,
         "metric": metric,
         # post-hook LR: EASGD's scale_lr multiplies by n_workers by default
@@ -97,6 +97,14 @@ def run_to_target(rule, *, devices, model_config: dict, target_error: float,
         "best_val_error": min(curve) if curve else None,
         "val_error_curve": curve,
     }
+    if metric != "error":
+        # self-describing aliases (ADVICE r4): a perplexity row otherwise
+        # reports its values only under error-named keys, disambiguated by
+        # nothing but the ``metric`` field.  The error-named keys stay for
+        # cross-metric consumers (``_better``, the sweep summaries).
+        row[f"best_val_{metric}"] = row["best_val_error"]
+        row[f"val_{metric}_curve"] = curve
+    return row
 
 
 def _better(a: dict, b: dict) -> bool:

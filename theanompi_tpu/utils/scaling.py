@@ -119,7 +119,10 @@ def _have_xplane_protos() -> bool:
     try:
         return importlib.util.find_spec(
             "tensorflow.tsl.profiler.protobuf.xplane_pb2") is not None
-    except (ImportError, ModuleNotFoundError):
+    except Exception:
+        # the intent is "null comm_share instead of crashing" on ANY broken
+        # tensorflow install — find_spec can raise more than ImportError
+        # (e.g. a protobuf version mismatch during package init, ADVICE r4)
         return False
 
 
@@ -192,6 +195,10 @@ def measure_scaling(
         "n_epochs": 1, "augment": False, "verbose": False,
     }
     per_n = {}
+    # probed ONCE before the loop (ADVICE r4: calling it per n re-imported
+    # tensorflow every iteration) — and only when some rung will measure
+    # comm share at all (the n=1 rung has no collectives to profile)
+    have_xplane = any(n > 1 for n in ns) and _have_xplane_protos()
     for n in ns:
         trainer, batches = _build(model_name, model_config, n, strategy)
         # warmup: compile both programs' first dispatch
@@ -213,10 +220,9 @@ def measure_scaling(
             # parser needs tensorflow's profiler protos — on a JAX-only
             # install record comm_share as null instead of crashing
             # (ADVICE r3 #1); the differential column below remains the
-            # only estimate in that case.  Availability is probed once
-            # up front (``_have_xplane_protos``) so no profiled run is
-            # wasted and unrelated ImportErrors still surface.
-            if _have_xplane_protos():
+            # only estimate in that case.  Availability was probed once
+            # before the loop so no profiled run is wasted.
+            if have_xplane:
                 comm_share, comm_s, _ = measure_comm_share(
                     trainer, batches, steps=steps)
             else:
