@@ -134,6 +134,13 @@ def build_trainer(model_name: str, platform: str):
                "dropout": 0.0, "n_train": bs * 8, "n_val": bs * 2}
         if "BENCH_FUSED_LOSS" in os.environ:
             cfg["fused_loss"] = bool(int(os.environ["BENCH_FUSED_LOSS"]))
+        # scan-unroll A/B knob (r5): the V=32k roofline puts ~27% of the
+        # step in while self-time, and the bench model's ONLY scans are
+        # the fused-loss chunk scans (the base TransformerLM trunk is a
+        # Python-loop Sequential — layers_unroll applies to the pipeline
+        # variant, which bench never builds)
+        if "BENCH_LOSS_UNROLL" in os.environ:
+            cfg["loss_unroll"] = int(os.environ["BENCH_LOSS_UNROLL"])
     else:
         from theanompi_tpu.models.wide_resnet import WideResNet as cls
 
@@ -301,7 +308,8 @@ def _measure():
     saved = {}
     for k in ("BENCH_BS", "BENCH_SEQ", "BENCH_VOCAB", "BENCH_FUSED_LOSS",
               "BENCH_STEPS", "BENCH_TRIALS", "BENCH_FEED",
-              "BENCH_DIM", "BENCH_LAYERS", "BENCH_NSUBB"):
+              "BENCH_DIM", "BENCH_LAYERS", "BENCH_NSUBB",
+              "BENCH_LOSS_UNROLL"):
         if k in os.environ:
             saved[k] = os.environ.pop(k)
     try:

@@ -213,3 +213,37 @@ def test_fused_lm_xent_vocab_parallel_matches_unsharded():
     for a, bb, name in zip(gv, gr, ("dh", "dw", "db")):
         np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
                                    rtol=1e-4, atol=1e-6, err_msg=name)
+
+
+def test_fused_lm_xent_unroll_exact_match():
+    """unroll>1 is a scheduling hint, not a numerics change: loss, metrics,
+    and all grads must be bit-comparable to the unroll=1 scan (r5 knob for
+    the while-self-time share in ROOFLINE_transformer_32k.json).  Also
+    covers the non-divisible case (4 chunks, unroll=3)."""
+    from theanompi_tpu.ops.losses import fused_lm_xent
+
+    r = np.random.RandomState(1)
+    bsz, t, d, vocab = 2, 16, 12, 37
+    h = jnp.asarray(r.randn(bsz, t, d).astype(np.float32))
+    w = jnp.asarray(r.randn(d, vocab).astype(np.float32) * 0.2)
+    b = jnp.asarray(r.randn(vocab).astype(np.float32) * 0.1)
+    y = jnp.asarray(r.randint(0, vocab, size=(bsz, t)))
+
+    def run(unroll):
+        def f(h, w, b):
+            out = fused_lm_xent(h, w, b, y, chunk_tokens=8, unroll=unroll)
+            return out[0], (out[1], out[2])
+
+        (loss, errs), grads = jax.value_and_grad(
+            f, argnums=(0, 1, 2), has_aux=True)(h, w, b)
+        return loss, errs, grads
+
+    l1, e1, g1 = run(1)
+    for u in (3, 4):
+        lu, eu, gu = run(u)
+        np.testing.assert_allclose(float(lu), float(l1), rtol=1e-6)
+        for a, bb in zip(eu, e1):
+            np.testing.assert_allclose(float(a), float(bb), rtol=1e-6)
+        for a, bb, name in zip(gu, g1, ("dh", "dw", "db")):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                       rtol=1e-5, atol=1e-7, err_msg=name)

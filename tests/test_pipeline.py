@@ -178,3 +178,43 @@ def test_pp2_tp2_with_fused_vocab_parallel_loss():
         np.asarray(t1.params["head"]["w"]), np.asarray(hw),
         rtol=2e-4, atol=2e-5,
     )
+
+
+def test_scan_unroll_matches_rolled():
+    """layers_unroll/loss_unroll are scheduling hints: multi-step training
+    must track the rolled (unroll=1) run on identical inits (r5 knobs for
+    the while-self-time share in ROOFLINE_transformer_32k.json).
+
+    loss_unroll is exercised on the base TransformerLM (its only scans are
+    the fused-loss chunk scans); layers_unroll on PipelineTransformerLM —
+    the ONLY model with a stacked-layer scan (the base trunk is a
+    Python-loop Sequential, where the knob is inert by design).
+    """
+    from theanompi_tpu.models.transformer_lm import TransformerLM
+
+    mesh = make_mesh(n_data=1, devices=jax.devices()[:1])
+    base = {"batch_size": 4, "n_train": 32, "n_val": 16, "seq_len": 16,
+            "vocab": 4096, "dim": 32, "heads": 4, "n_layers": 4,
+            "dropout": 0.0, "n_epochs": 1, "precision": "fp32",
+            "fused_loss": True}
+
+    def run(model_cls, extra):
+        model = model_cls({**base, **extra})
+        t = BSPTrainer(model, mesh=mesh)
+        t.compile_iter_fns()
+        t.init_state()
+        batches = list(model.data.train_batches(t.global_batch, 0, seed=0))
+        return [
+            float(t.train_iter(batches[i % len(batches)], lr=1e-2)["cost"])
+            for i in range(3)
+        ]
+
+    rolled = run(TransformerLM, {})
+    unrolled = run(TransformerLM, {"loss_unroll": 2})
+    np.testing.assert_allclose(unrolled, rolled, rtol=1e-5)
+
+    pp_cfg = {"n_micro": 2}
+    pp_rolled = run(PipelineTransformerLM, pp_cfg)
+    pp_unrolled = run(PipelineTransformerLM,
+                      {**pp_cfg, "layers_unroll": 4, "loss_unroll": 2})
+    np.testing.assert_allclose(pp_unrolled, pp_rolled, rtol=1e-5)

@@ -140,6 +140,14 @@ class TransformerLM(SupervisedModel):
         # "auto": pallas flash attention when shapes allow (TPU-compiled,
         # interpreted on CPU); "blockwise"/"pallas" force a path
         "attn_impl": "auto",
+        # lax.scan unroll factors — the V=32k roofline attributes ~27 % of
+        # the step to while self-time (ROOFLINE_transformer_32k.json), all
+        # of it the fused-loss chunk scans in this base model (the trunk
+        # is a Python-loop Sequential, not a scan).  loss_unroll lets XLA
+        # software-pipeline the loss chunks; layers_unroll applies ONLY to
+        # PipelineTransformerLM's stacked-layer scan.  1 = the r4 behavior.
+        "layers_unroll": 1,
+        "loss_unroll": 1,
     }
 
     def build_data(self):
@@ -241,12 +249,15 @@ class TransformerLM(SupervisedModel):
                                         train=train, rng=rng)
         w, b = cp["head"]["w"], cp["head"].get("b")
         if self.fused_loss_enabled():
+            unroll = int(self.config.get("loss_unroll", 1) or 1)
             if axis_bound(MODEL_AXIS) and jax.lax.axis_size(MODEL_AXIS) > 1:
                 # w/b are this shard's vocab slice (see _head_specs)
                 loss, err1, err5 = fused_lm_xent_vp(h, w, b, batch["y"],
-                                                    MODEL_AXIS)
+                                                    MODEL_AXIS,
+                                                    unroll=unroll)
             else:
-                loss, err1, err5 = fused_lm_xent(h, w, b, batch["y"])
+                loss, err1, err5 = fused_lm_xent(h, w, b, batch["y"],
+                                                 unroll=unroll)
         else:
             logits, _ = self._head.apply(cp["head"], {}, h)
             loss = softmax_cross_entropy(logits, batch["y"])
@@ -444,7 +455,9 @@ class PipelineTransformerLM(TransformerLM):
                 y, _ = self._block.apply(bp, {}, a, train=train, rng=kb)
                 return (y, key), None
 
-            (act, _), _ = jax.lax.scan(one, (act, key0), chunk)
+            (act, _), _ = jax.lax.scan(
+                one, (act, key0), chunk,
+                unroll=int(cfg.get("layers_unroll", 1) or 1))
             return act
 
         h = pipeline_apply(stage_fn, params["blocks"], emb, cfg["n_micro"])

@@ -99,7 +99,7 @@ def _chunk_stats(hc, yc, w, b, v, axis):
 
 
 def _lm_xent_scan(h3, w, b, y2, mask2, cfg, axis):
-    n, v = cfg
+    n, v, unroll = cfg
 
     def body(carry, xs):
         hc, yc, mc = xs
@@ -113,7 +113,8 @@ def _lm_xent_scan(h3, w, b, y2, mask2, cfg, axis):
         ), lse
 
     zero = jnp.zeros((), jnp.float32)
-    (ls, c1, c5), lse2 = lax.scan(body, (zero, zero, zero), (h3, y2, mask2))
+    (ls, c1, c5), lse2 = lax.scan(body, (zero, zero, zero), (h3, y2, mask2),
+                                  unroll=unroll)
     return ls / n, c1 / n, c5 / n, lse2
 
 
@@ -124,7 +125,7 @@ def _lm_xent_fwd(h3, w, b, y2, mask2, cfg, axis):
 
 def _lm_xent_bwd(cfg, axis, res, cts):
     h3, w, b, y2, mask2, lse2 = res
-    n, v = cfg
+    n, v, unroll = cfg
     g = cts[0] / n  # error cotangents drop: step functions, zero-grad a.e.
     ids = jnp.arange(v, dtype=y2.dtype)
     # vocab-sharded: labels offset to local ids (out-of-range matches none)
@@ -148,7 +149,8 @@ def _lm_xent_bwd(cfg, axis, res, cts):
 
     dw0 = jnp.zeros(w.shape, jnp.float32)
     db0 = jnp.zeros(b.shape, jnp.float32)
-    (dw, db), dh3 = lax.scan(body, (dw0, db0), (h3, y2, mask2, lse2))
+    (dw, db), dh3 = lax.scan(body, (dw0, db0), (h3, y2, mask2, lse2),
+                             unroll=unroll)
     if axis is not None:
         # h is replicated over the vocab axis; each shard's dh is the
         # partial from its slice (the Megatron-f pin, explicit here)
@@ -185,7 +187,8 @@ def _chunk_and_pad(h, labels, v: int, chunk_tokens: int | None):
 
 
 def fused_lm_xent(h: jax.Array, w: jax.Array, b: jax.Array | None,
-                  labels: jax.Array, chunk_tokens: int | None = None):
+                  labels: jax.Array, chunk_tokens: int | None = None,
+                  unroll: int = 1):
     """Fused LM-head softmax cross entropy -> ``(loss, top1_err, top5_err)``.
 
     ``h``: trunk output ``[..., D]``; ``w``: head weight ``[D, V]``; ``b``:
@@ -197,18 +200,23 @@ def fused_lm_xent(h: jax.Array, w: jax.Array, b: jax.Array | None,
     chunks starve the MXU at 88 ms where 1024-4096 all sit near 60 ms —
     within ~4% of the naive [N, V]-materializing path's speed while
     keeping O(N) memory).  N is zero-padded to the chunk and masked, so
-    no divisibility is required of the caller.
+    no divisibility is required of the caller.  ``unroll`` feeds the
+    chunk scans (fwd + custom bwd) — the V=32k profile attributes ~27 %
+    of the LM step to ``while`` self-time (carry/slice overhead and
+    inter-iteration stalls, ROOFLINE_transformer_32k.json), which
+    unrolling lets XLA software-pipeline away at the cost of code size.
     """
     v = w.shape[-1]
     h3, y2, mask2, n = _chunk_and_pad(h, labels, v, chunk_tokens)
     if b is None:
         b = jnp.zeros((v,), jnp.float32)
-    return _lm_xent(h3, w, b, y2, mask2, (n, v), None)
+    return _lm_xent(h3, w, b, y2, mask2, (n, v, unroll), None)
 
 
 def fused_lm_xent_vp(h: jax.Array, w_local: jax.Array,
                      b_local: jax.Array | None, labels: jax.Array,
-                     axis_name: str, chunk_tokens: int | None = None):
+                     axis_name: str, chunk_tokens: int | None = None,
+                     unroll: int = 1):
     """Vocab-parallel fused LM loss -> ``(loss, top1_err, top5_err)``.
 
     Megatron parallel cross entropy: ``w_local``/``b_local`` are this
@@ -223,7 +231,8 @@ def fused_lm_xent_vp(h: jax.Array, w_local: jax.Array,
     h3, y2, mask2, n = _chunk_and_pad(h, labels, v_local, chunk_tokens)
     if b_local is None:
         b_local = jnp.zeros((v_local,), jnp.float32)
-    return _lm_xent(h3, w_local, b_local, y2, mask2, (n, v_local), axis_name)
+    return _lm_xent(h3, w_local, b_local, y2, mask2, (n, v_local, unroll),
+                    axis_name)
 
 
 def top_k_error(logits: jax.Array, labels: jax.Array, k: int = 1) -> jax.Array:

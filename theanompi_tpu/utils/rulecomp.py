@@ -211,6 +211,18 @@ ALPHA_SWEEP = [{"alpha": 0.05}, {"alpha": 0.1125}, {"alpha": 0.3},
                {"alpha": 0.1125, "scale_lr": False},
                {"alpha": 0.3, "scale_lr": False}]
 
+#: VERDICT r4 #5: the r4 grid's smallest α (0.05) may simply still be too
+#: large at τ=16 — the EASGD paper's stability condition couples α to τ
+#: (smaller α at larger τ).  The deep sweep extends a full decade below,
+#: all at the unscaled lr the r4 diagnosis validated; if every rung fails
+#: while LocalSGD τ=16 passes, "elastic coupling fails at every α ≤ 0.05"
+#: upgrades to "… at every α ≥ 0.00125 in a two-decade range" — a
+#: scale-bound verdict, not a mis-parameterization.
+ALPHA_SWEEP_DEEP = ALPHA_SWEEP + [
+    {"alpha": a, "scale_lr": False}
+    for a in (0.00125, 0.0025, 0.005, 0.0125, 0.025, 0.05)
+]
+
 
 def _diagnose(results: list[dict]) -> list[str]:
     """Name the failing factor per τ from the grid + control rows."""
@@ -249,10 +261,17 @@ def _diagnose(results: list[dict]) -> list[str]:
                 f"(epochs_to_target={e['epochs_to_target']}) — {why}"
             )
         elif c["reached"]:
+            alphas = sorted({
+                s["rule_overrides"]["alpha"]
+                for s in e.get("sweep", [])
+                if s.get("rule_overrides", {}).get("alpha") is not None
+            })
+            span = (f" (alpha swept {alphas[0]}–{alphas[-1]}, "
+                    f"{len(alphas)} rungs)") if alphas else ""
             out.append(
-                f"easgd_tau{tau}: fails at every (lr, alpha) in the grid "
-                f"while the plain-averaging control localsgd_tau{tau} "
-                f"reaches the target (epochs_to_target="
+                f"easgd_tau{tau}: fails at every (lr, alpha) in the "
+                f"grid{span} while the plain-averaging control "
+                f"localsgd_tau{tau} reaches the target (epochs_to_target="
                 f"{c['epochs_to_target']}, base_lr={c['base_lr']}) — "
                 f"tau-stale exchange per se is fine at this scale; the "
                 f"ELASTIC COUPLING is the failing factor"
@@ -282,7 +301,8 @@ def diagnose_easgd_tau(devices=8, model_config: dict | None = None,
         ("bsp", "BSP", {}),
         ("easgd_tau1", "EASGD", {"tau": 1}),
         ("easgd_tau4", "EASGD", {"tau": 4}, ALPHA_SWEEP),
-        ("easgd_tau16", "EASGD", {"tau": 16}, ALPHA_SWEEP),
+        # τ=16 gets the two-decade α sweep (VERDICT r4 #5)
+        ("easgd_tau16", "EASGD", {"tau": 16}, ALPHA_SWEEP_DEEP),
         ("localsgd_tau4", "LocalSGD", {"tau": 4}),
         ("localsgd_tau16", "LocalSGD", {"tau": 16}),
         ("gosgd", "GOSGD", {}),
