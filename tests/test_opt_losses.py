@@ -168,10 +168,14 @@ def test_fused_lm_xent_no_bias():
     np.testing.assert_allclose(lf, ln, rtol=1e-5)
 
 
-def test_fused_lm_xent_vocab_parallel_matches_unsharded():
+@pytest.mark.parametrize("unroll", [1, 2])
+def test_fused_lm_xent_vocab_parallel_matches_unsharded(unroll):
     """Megatron parallel CE: the vocab-sharded fused loss (head
     P(None, model)) must reproduce the unsharded fused loss — value,
-    metrics, and all grads, including the psum-pinned h-cotangent."""
+    metrics, and all grads, including the psum-pinned h-cotangent.
+    ``unroll=2`` proves the r5 scan-unroll knob composes with the
+    collective-assembled softmax (the reference here stays rolled, so
+    this is a cross-unroll equality, stronger than same-vs-same)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from theanompi_tpu.ops.losses import fused_lm_xent, fused_lm_xent_vp
@@ -195,7 +199,7 @@ def test_fused_lm_xent_vocab_parallel_matches_unsharded():
 
     def vp(h, w, b):
         loss, e1, e5 = fused_lm_xent_vp(h, w, b, y, MODEL_AXIS,
-                                        chunk_tokens=8)
+                                        chunk_tokens=8, unroll=unroll)
         return loss, (e1, e5)
 
     f = jax.jit(shard_map(
