@@ -1,0 +1,39 @@
+"""Timing-discipline lint (ISSUE 1 satellite): no wall-clock in timed paths.
+
+``time.time()`` is NTP-steppable and low-resolution; every duration in
+``theanompi_tpu/`` (recorder splits, telemetry spans, bench protocols)
+must come from ``time.perf_counter()``.  This pytest-collected static
+check fails the build the moment a wall-clock call sneaks into package
+code or the bench entrypoint — wall-clock *stamps* (ISO strings for run
+ids / session metadata) use ``time.strftime``/``datetime``, which the
+lint deliberately permits.
+
+A genuinely wall-clock-needing line can opt out with a ``lint: wall-ok``
+comment, which keeps the exception visible at the call site.
+"""
+
+import pathlib
+import re
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+PATTERN = re.compile(r"\btime\.time\(\)")
+ALLOW_MARK = "lint: wall-ok"
+
+
+def _python_files():
+    yield from sorted((REPO / "theanompi_tpu").rglob("*.py"))
+    yield REPO / "bench.py"
+
+
+def test_no_wall_clock_in_timed_paths():
+    offenders = []
+    for path in _python_files():
+        for lineno, line in enumerate(
+                path.read_text().splitlines(), start=1):
+            if PATTERN.search(line) and ALLOW_MARK not in line:
+                offenders.append(
+                    f"{path.relative_to(REPO)}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "time.time() in timed paths — use time.perf_counter() for "
+        "durations (or mark the line 'lint: wall-ok' if wall time is "
+        "genuinely required):\n" + "\n".join(offenders))

@@ -16,11 +16,16 @@ from theanompi_tpu.utils import scaling
 
 
 def test_runbook_scaling_command(tmp_path):
-    """RUNBOOK steps 1-3 at toy scale: same flags, tiny steps/batch."""
+    """RUNBOOK steps 1-3 at toy scale: same flags, tiny steps/batch/images
+    (--set shrinks the conv geometry so the CPU dry-run compiles in seconds
+    rather than minutes — the flags and artifact schema stay the real ones)."""
     out = str(tmp_path / "SCALING_v5e16_host.json")
     scaling.main([
         "--model", "resnet50",
         "--batch-size", "4", "--ns", "1,2", "--steps", "2", "--trials", "1",
+        "--set", "image_size=32", "--set", "store_size=40",
+        "--set", "n_classes=4", "--set", "n_train=32", "--set", "n_val=16",
+        "--set", "shard_size=16", "--set", "precision=fp32",
         "--strategy", "psum_bf16", "--out", out,
     ])
     art = json.load(open(out))
@@ -38,6 +43,7 @@ def test_runbook_scaling_command(tmp_path):
 def test_runbook_launcher_command(tmp_path):
     """RUNBOOK step 4's tmlauncher invocation, shrunk to one tiny epoch."""
     record = str(tmp_path / "record")
+    telemetry = str(tmp_path / "telemetry")
     rc = launcher.main([
         "--rule", "BSP", "--devices", "8",
         "--modelfile", "theanompi_tpu.models.resnet50",
@@ -47,8 +53,14 @@ def test_runbook_launcher_command(tmp_path):
         "--set", "n_classes=4", "--set", "n_train=32", "--set", "n_val=16",
         "--set", "shard_size=16", "--set", "precision=fp32",
         "--rule-set", "exch_strategy=psum_bf16",
-        "--record-dir", record, "--quiet",
+        "--record-dir", record, "--telemetry-dir", telemetry, "--quiet",
     ])
     assert rc == 0
     # the recorder histories the RUNBOOK points at
     assert any(f.endswith(".npy") for f in os.listdir(record))
+    # the telemetry artifacts the RUNBOOK's observability step points at
+    files = os.listdir(telemetry)
+    assert any(f.startswith("events-rank") for f in files)
+    assert "trace.json" in files and "summary.json" in files
+    trace = json.load(open(os.path.join(telemetry, "trace.json")))
+    assert trace["traceEvents"]

@@ -1,0 +1,330 @@
+"""ISSUE 1 telemetry layer: sinks, spans, Chrome traces, byte accounting.
+
+Acceptance criteria under test (CPU mesh):
+
+- a 5-step BSP run with telemetry enabled emits JSONL that validates
+  against the documented schema (``telemetry/sink.py``), plus a Chrome
+  trace-event file loadable as JSON whose nested spans sum consistently
+  with the Recorder splits;
+- per-exchange wire-byte counts halve when the strategy switches
+  ``psum`` -> ``psum_bf16``;
+- with telemetry disabled, ``run()`` makes zero telemetry calls.
+"""
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from theanompi_tpu import BSP
+from theanompi_tpu.models.wide_resnet import WideResNet
+from theanompi_tpu.parallel.bsp import BSPTrainer
+from theanompi_tpu.parallel.exchanger import (
+    Exchanger,
+    collective_wire_bytes,
+    wire_itemsize,
+)
+from theanompi_tpu.parallel.mesh import make_mesh
+from theanompi_tpu.telemetry import Telemetry, read_events, sink_files
+from theanompi_tpu.telemetry.sink import EventSink
+from theanompi_tpu.utils.recorder import Recorder
+
+TINY = {
+    "depth": 10, "widen": 1, "batch_size": 2, "image_size": 8,
+    "n_train": 80, "n_val": 16, "n_epochs": 1, "precision": "fp32",
+    "augment": False, "verbose": False,
+}
+
+# the schema contract from telemetry/sink.py — every event must carry these
+REQUIRED_KEYS = {"ts", "kind", "name", "rank"}
+KIND_KEYS = {"span": {"dur", "tid"}, "counter": {"value", "total"},
+             "gauge": {"value"}}
+KINDS = {"meta", "span", "instant", "counter", "gauge", "metrics"}
+
+
+def _validate(ev: dict) -> None:
+    missing = REQUIRED_KEYS - ev.keys()
+    assert not missing, f"event missing {missing}: {ev}"
+    assert ev["kind"] in KINDS, ev
+    assert isinstance(ev["ts"], (int, float)) and isinstance(ev["rank"], int)
+    extra = KIND_KEYS.get(ev["kind"], set()) - ev.keys()
+    assert not extra, f"{ev['kind']} event missing {extra}: {ev}"
+
+
+def _run_bsp(telemetry_dir: str, strategy: str, n_train: int = 80):
+    cfg = dict(TINY, n_train=n_train)
+    rule = BSP(config={"verbose": False, "telemetry_dir": telemetry_dir,
+                       "print_freq": 2, "exch_strategy": strategy})
+    rule.init(devices=8, model_config=cfg)
+    return rule.wait()
+
+
+@pytest.fixture(scope="module")
+def bsp_run(tmp_path_factory):
+    """One 5-step BSP/psum training run with telemetry on, shared below."""
+    d = str(tmp_path_factory.mktemp("tel_psum"))
+    rec = _run_bsp(d, "psum")
+    events = []
+    for p in sink_files(d):
+        events.extend(read_events(p))
+    return d, rec, events
+
+
+def test_jsonl_validates_schema(bsp_run):
+    d, _, events = bsp_run
+    assert events, "no events emitted"
+    for ev in events:
+        _validate(ev)
+    names = {e["name"] for e in events}
+    # the spans the tentpole names: recorder splits, prefetch dequeues,
+    # exchange accounting, step spans, validation
+    for required in ("train.step", "recorder.calc", "recorder.wait",
+                     "prefetch.dequeue", "validate", "exchange.accounting",
+                     "metrics", "session"):
+        assert required in names, f"missing {required} in {sorted(names)}"
+    steps = [e for e in events if e["name"] == "train.step"]
+    assert len(steps) == 5  # n_train=80 / (batch 2 * 8 workers)
+    assert [e["step"] for e in steps] == list(range(5))
+
+
+def test_chrome_trace_loads_and_nests(bsp_run):
+    d, rec, events = bsp_run
+    trace = json.load(open(os.path.join(d, "trace.json")))
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert xs, "no complete spans in the chrome trace"
+    steps = [e for e in xs if e["name"] == "train.step"]
+    calcs = [e for e in xs if e["name"] == "recorder.calc"]
+    assert len(steps) == 5 and len(calcs) == 5
+    # nesting: every calc span sits inside exactly one step span (eps for
+    # float us rounding)
+    for c in calcs:
+        inside = [s for s in steps
+                  if s["ts"] - 1 <= c["ts"]
+                  and c["ts"] + c["dur"] <= s["ts"] + s["dur"] + 1]
+        assert len(inside) == 1, (c, steps)
+    # span durations sum consistently with the Recorder's splits: they are
+    # the same measurements by construction
+    for seg in ("calc", "wait"):
+        span_sum = sum(e["dur"] for e in events
+                       if e["kind"] == "span" and e["name"] == f"recorder.{seg}")
+        assert span_sum == pytest.approx(sum(rec.time_history[seg]), rel=1e-9)
+
+
+def test_summary_has_step_stats_and_counters(bsp_run):
+    d, _, _ = bsp_run
+    summary = json.load(open(os.path.join(d, "summary.json")))
+    assert summary["n_ranks"] == 1
+    row = summary["per_rank"]["0"]
+    assert row["steps"] == 5
+    assert row["step_ms"]["p50"] > 0
+    assert row["segment_totals_s"]["calc"] > 0
+    assert row["counters"]["exchange.wire_bytes"] > 0
+
+
+def test_wire_bytes_halve_psum_to_bf16(bsp_run, tmp_path):
+    """Acceptance: emitted per-exchange byte counts halve under bf16."""
+    d, _, events = bsp_run
+    acc = [e for e in events if e["name"] == "exchange.accounting"]
+    assert len(acc) == 1 and acc[0]["strategy"] == "psum"
+    per_exchange = acc[0]["bytes_per_exchange"]
+    assert per_exchange > 0 and acc[0]["n_workers"] == 8
+    # per-step counters accumulate one exchange per step
+    counters = [e for e in events if e["kind"] == "counter"
+                and e["name"] == "exchange.wire_bytes"]
+    assert len(counters) == 5
+    assert counters[-1]["total"] == 5 * per_exchange
+
+    d2 = str(tmp_path / "tel_bf16")
+    _run_bsp(d2, "psum_bf16", n_train=32)  # 2 steps: accounting is static
+    ev2 = [e for p in sink_files(d2) for e in read_events(p)]
+    acc2 = [e for e in ev2 if e["name"] == "exchange.accounting"]
+    assert len(acc2) == 1 and acc2[0]["strategy"] == "psum_bf16"
+    assert acc2[0]["bytes_per_exchange"] * 2 == per_exchange
+
+
+def test_exchanger_wire_bytes_model():
+    """Static accounting unit: wire dtype per strategy, ring factor, and
+    the non-float skip matching what exchange() actually reduces."""
+    tree = {"w": np.zeros((64, 32), np.float32),
+            "b": np.zeros((32,), np.float32),
+            "step": np.zeros((), np.int32)}
+    n_float = 64 * 32 + 32
+    n = 8
+    ring = lambda b: 2 * (n - 1) * b // n  # noqa: E731
+    assert Exchanger("psum").wire_bytes(tree, n) == ring(4 * n_float)
+    assert Exchanger("psum_bf16").wire_bytes(tree, n) == ring(2 * n_float)
+    assert Exchanger("ring").wire_bytes(tree, n) == ring(4 * n_float)
+    assert Exchanger("ring_bf16").wire_bytes(tree, n) == ring(2 * n_float)
+    assert Exchanger("none").wire_bytes(tree, n) == 0
+    # single worker: no wire traffic at all
+    assert Exchanger("psum").wire_bytes(tree, 1) == 0
+    # bf16 never inflates an already-narrow dtype
+    assert wire_itemsize("psum_bf16", np.float16) == 2
+    assert collective_wire_bytes(100, 1) == 0
+    # exact halving must survive element counts the ring factor floors
+    odd = {"w": np.zeros((7, 3), np.float32)}
+    assert (Exchanger("psum_bf16").wire_bytes(odd, n) * 2
+            == Exchanger("psum").wire_bytes(odd, n))
+
+
+def test_sink_rotation_bounded(tmp_path):
+    sink = EventSink(str(tmp_path), rank=3, max_bytes=512, keep=2)
+    for i in range(200):
+        sink.emit({"ts": float(i), "kind": "instant", "name": "x", "rank": 3,
+                   "i": i})
+    sink.close()
+    files = sink_files(str(tmp_path), rank=3)
+    # live file + at most `keep` rotated generations, all parseable
+    assert 1 <= len(files) <= 3
+    assert all("rank00003" in f for f in files)
+    events = [e for f in files for e in read_events(f)]
+    assert events and all(e["name"] == "x" for e in events)
+    # rotation keeps the NEWEST events (the live file ends at i=199)
+    assert events[-1]["i"] == 199
+
+
+def test_spans_nest_around_fake_train_loop(tmp_path):
+    """Satellite: telemetry spans nest correctly around a fake train loop."""
+    tel = Telemetry(str(tmp_path), rank=0)
+    for step in range(3):
+        with tel.span("train.step", step=step):
+            with tel.span("recorder.wait"):
+                pass
+            with tel.span("recorder.calc"):
+                time.sleep(0.002)
+    tel.close()
+    trace = json.load(open(tel.export_chrome_trace()))
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    steps = [e for e in xs if e["name"] == "train.step"]
+    inner = [e for e in xs if e["name"].startswith("recorder.")]
+    assert len(steps) == 3 and len(inner) == 6
+    for child in inner:
+        parents = [s for s in steps
+                   if s["ts"] <= child["ts"] + 1e-3
+                   and child["ts"] + child["dur"] <= s["ts"] + s["dur"] + 1e-3]
+        assert len(parents) == 1, (child, steps)
+    # spans carry their tags into trace args
+    assert sorted(s["args"]["step"] for s in steps) == [0, 1, 2]
+
+
+def test_multirank_aggregation_skew_and_straggler(tmp_path):
+    """The multihost path: per-rank sink files merged by rank 0 into a
+    cross-rank step-skew / straggler summary (durations only — perf_counter
+    epochs differ across hosts, so simultaneity is never compared)."""
+    from theanompi_tpu.telemetry import aggregate
+
+    # rank 1 is a 2x straggler on every step
+    for rank, scale in ((0, 1.0), (1, 2.0)):
+        sink = EventSink(str(tmp_path), rank=rank)
+        for step in range(4):
+            sink.emit({"ts": 100.0 * rank + step, "kind": "span",
+                       "name": "train.step", "rank": rank, "tid": 1,
+                       "dur": 0.010 * scale, "step": step})
+        sink.close()
+    summary = aggregate.finalize(str(tmp_path))
+    assert summary["n_ranks"] == 2
+    assert summary["step_skew_ms"]["steps_compared"] == 4
+    assert summary["step_skew_ms"]["mean"] == pytest.approx(10.0, rel=1e-6)
+    assert summary["straggler"]["rank"] == 1
+    assert summary["straggler"]["vs_fleet_mean"] == pytest.approx(4 / 3,
+                                                                  abs=1e-3)
+    # finalize also wrote the merged two-rank chrome trace
+    trace = json.load(open(tmp_path / "trace.json"))
+    assert {e["pid"] for e in trace["traceEvents"]} == {0, 1}
+
+
+def test_span_records_exception_and_still_closes(tmp_path):
+    tel = Telemetry(str(tmp_path), rank=0)
+    with pytest.raises(ValueError):
+        with tel.span("doomed"):
+            raise ValueError("boom")
+    # a manual fence-aware end() inside a with block must not double-emit
+    # when __exit__ runs
+    with tel.span("fenced") as s:
+        s.end(fence=None)
+    tel.close()
+    evs = [e for p in sink_files(str(tmp_path)) for e in read_events(p)]
+    doomed = [e for e in evs if e["name"] == "doomed"]
+    assert len(doomed) == 1 and doomed[0]["error"] == "ValueError"
+    assert len([e for e in evs if e["name"] == "fenced"]) == 1
+
+
+def test_disabled_run_makes_zero_telemetry_calls(monkeypatch):
+    """Acceptance: telemetry off (the default) -> not a single telemetry
+    call on the hot path.  Any construction or emission raises."""
+
+    def bomb(*a, **k):
+        raise AssertionError("telemetry call on a disabled run")
+
+    monkeypatch.setattr(EventSink, "__init__", bomb)
+    monkeypatch.setattr(EventSink, "emit", bomb)
+    monkeypatch.setattr(Telemetry, "__init__", bomb)
+    model = WideResNet(dict(TINY, n_train=32))
+    t = BSPTrainer(model, mesh=make_mesh(n_data=1, devices=jax.devices()[:1]),
+                   recorder=Recorder(verbose=False))
+    assert t.telemetry is None
+    rec = t.run()
+    assert len(rec.time_history["calc"]) == 16  # 32 / batch 2, ran clean
+
+
+def test_recorder_end_without_start_raises():
+    """Satellite: a clear error naming the segment, not a bare KeyError."""
+    r = Recorder(verbose=False)
+    with pytest.raises(RuntimeError, match=r"end\('comm'\).*never started"):
+        r.end("comm")
+    # an open unrelated segment is named in the message to aid debugging
+    r.start("calc")
+    with pytest.raises(RuntimeError, match="calc"):
+        r.end("wait")
+    r.cancel("calc")
+
+
+def test_recorder_save_load_roundtrip(tmp_path):
+    """Satellite: time/train/val histories + summary.json survive a
+    save/load cycle bit-exact."""
+    r = Recorder(verbose=False, print_freq=2, save_dir=str(tmp_path))
+    for i in range(1, 5):
+        r.start("wait"); r.end("wait")  # noqa: E702
+        r.start("calc"); r.end("calc")  # noqa: E702
+        r.end_iteration()
+        r.train_metrics(cost=float(i), error=float(i) / 10)
+        r.print_train_info(i)
+    r.val_metrics(0, cost=0.5, error=0.25)
+    r.save()
+    summary = json.load(open(tmp_path / "summary.json"))
+    assert summary["iters"] == 4
+    assert summary["last_val"] == {"epoch": 0, "cost": 0.5, "error": 0.25}
+
+    r2 = Recorder(verbose=False, save_dir=str(tmp_path))
+    r2.load()
+    for name in ("time_history", "train_history", "val_history"):
+        a, b = getattr(r, name), getattr(r2, name)
+        assert set(a) == set(b), name
+        for k in a:
+            assert list(a[k]) == list(b[k]), (name, k)
+
+
+def test_profiler_stopped_when_run_raises(monkeypatch, tmp_path):
+    """Satellite: run() must stop an open profiler window on ANY exit —
+    here an exception thrown while the window is still open."""
+    calls = {"start": 0, "stop": 0}
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.__setitem__("start", calls["start"] + 1))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.__setitem__("stop", calls["stop"] + 1))
+    model = WideResNet(dict(TINY, n_train=32))
+    t = BSPTrainer(model, mesh=make_mesh(n_data=1, devices=jax.devices()[:1]),
+                   recorder=Recorder(verbose=False),
+                   profile_dir=str(tmp_path), profile_window=(0, 10**9))
+
+    def exploding_validate(epoch):
+        raise RuntimeError("mid-run failure with the window open")
+
+    monkeypatch.setattr(t, "validate", exploding_validate)
+    with pytest.raises(RuntimeError, match="window open"):
+        t.run()
+    assert calls == {"start": 1, "stop": 1}
+    assert not t._profiling

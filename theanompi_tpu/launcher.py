@@ -79,6 +79,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--config-json", default=None,
                    help="path to a JSON file with {'model': {...}, 'rule': {...}}")
     p.add_argument("--record-dir", default=None)
+    p.add_argument("--telemetry-dir", default=None,
+                   help="enable structured telemetry: per-rank JSONL event "
+                   "sinks under this dir; rank 0 writes trace.json "
+                   "(Perfetto-loadable) + summary.json (cross-rank skew) "
+                   "at the end of the run")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--seed", type=int, default=0)
@@ -102,6 +107,8 @@ def main(argv: list[str] | None = None) -> int:
     rule_config.setdefault("seed", args.seed)
     if args.record_dir:
         rule_config["record_dir"] = args.record_dir
+    if args.telemetry_dir:
+        rule_config["telemetry_dir"] = args.telemetry_dir
     if args.checkpoint_dir:
         rule_config["checkpoint_dir"] = args.checkpoint_dir
     if args.resume:
@@ -125,6 +132,10 @@ def main(argv: list[str] | None = None) -> int:
     if not args.quiet:
         last = {k: v[-1] for k, v in recorder.val_history.items() if v}
         print(f"tmlauncher: done. final val: {last}", flush=True)
+        if args.telemetry_dir:
+            print(f"tmlauncher: telemetry in {args.telemetry_dir} "
+                  f"(trace.json for Perfetto, summary.json for skew)",
+                  flush=True)
     return 0
 
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 from theanompi_tpu.utils.helper_funcs import shard_batch
 
@@ -33,11 +34,16 @@ class Prefetcher:
     An exception in the source iterator is re-raised at the consuming site.
     """
 
-    def __init__(self, it, mesh=None, depth: int = 2, spec=None):
+    def __init__(self, it, mesh=None, depth: int = 2, spec=None,
+                 telemetry=None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._it = it
+        # optional telemetry: each dequeue emits a span with the residual
+        # queue depth, so a starving pipeline is visible in the trace as
+        # long prefetch.dequeue spans at qsize 0
+        self._telemetry = telemetry
         self._err: BaseException | None = None
         self._stop = threading.Event()
 
@@ -72,12 +78,17 @@ class Prefetcher:
         return self
 
     def __next__(self):
+        tel = self._telemetry
+        t0 = time.perf_counter() if tel is not None else 0.0
         item = self._q.get()
         if item is _END:
             self._thread.join()
             if self._err is not None:
                 raise self._err
             raise StopIteration
+        if tel is not None:
+            tel.emit_span("prefetch.dequeue", t0,
+                          time.perf_counter() - t0, qsize=self._q.qsize())
         return item
 
     def close(self) -> None:
@@ -116,9 +127,10 @@ class Prefetcher:
             close()
 
 
-def prefetch(it, mesh=None, depth: int = 2, spec=None):
+def prefetch(it, mesh=None, depth: int = 2, spec=None, telemetry=None):
     """``depth=0`` disables prefetching (pass-through), else wraps in a
     :class:`Prefetcher`."""
     if depth == 0:
         return it
-    return Prefetcher(it, mesh=mesh, depth=depth, spec=spec)
+    return Prefetcher(it, mesh=mesh, depth=depth, spec=spec,
+                      telemetry=telemetry)

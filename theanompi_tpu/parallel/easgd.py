@@ -83,6 +83,7 @@ class EASGDTrainer(BaseTrainer):
         self.center = None
         self._exchange_fn = None
         self._consensus_state_fn = None
+        self._elastic_wire_bytes: int | None = None
 
     def _exchange_pair(self, params, center):
         """The periodic exchange, on UNSTACKED per-worker params; the
@@ -139,6 +140,29 @@ class EASGDTrainer(BaseTrainer):
             self.recorder.start("comm")
             self.params, self.center = self._exchange_fn(self.params, self.center)
             self.recorder.end("comm")
+            if self.telemetry is not None:
+                # iteration was already advanced by train_iter: the
+                # exchange belongs to the step just finished, whose
+                # train.step span is tagged with the pre-increment index
+                self.telemetry.count(
+                    "exchange.wire_bytes", self._periodic_wire_bytes(),
+                    emit=True, step=self.iteration - 1)
+
+    def _periodic_wire_bytes(self) -> int:
+        """Static ICI accounting for one elastic round: the only collective
+        is the fp32 ``psum(p - c)`` over one params-sized tree (see
+        :func:`elastic_exchange`) — ring traffic of that buffer."""
+        if self._elastic_wire_bytes is None:
+            from theanompi_tpu.parallel.exchanger import collective_wire_bytes
+
+            total = sum(
+                leaf.size * leaf.dtype.itemsize
+                for leaf in jax.tree.leaves(self.center)
+                if jnp.issubdtype(leaf.dtype, jnp.inexact)
+            )
+            self._elastic_wire_bytes = collective_wire_bytes(
+                total, self.n_workers)
+        return self._elastic_wire_bytes
 
     def warmup_exchange(self) -> None:
         self.params, self.center = self._exchange_fn(self.params, self.center)

@@ -38,6 +38,37 @@ from theanompi_tpu.parallel.mesh import DATA_AXIS
 # strategy name -> fn(x, axis_name, axis_size) -> mean-reduced x
 STRATEGIES: dict[str, Callable] = {}
 
+#: strategies that put float leaves on the wire in bf16 (2 bytes/elem)
+_BF16_WIRE = ("psum_bf16", "ring_bf16")
+
+
+def wire_itemsize(strategy: str, dtype) -> int:
+    """Bytes per element a leaf of ``dtype`` occupies on the ICI wire.
+
+    The telemetry layer cannot observe the collective (it is fused into one
+    XLA program), so bytes are accounted *statically* from the strategy's
+    wire dtype: the bf16 strategies compress floating leaves to 2 bytes;
+    everything else ships the leaf dtype verbatim; ``none`` ships nothing.
+    """
+    if strategy == "none":
+        return 0
+    itemsize = jnp.dtype(dtype).itemsize
+    if strategy in _BF16_WIRE and jnp.issubdtype(dtype, jnp.floating):
+        return min(itemsize, 2)
+    return itemsize
+
+
+def collective_wire_bytes(buffer_bytes: int, axis_size: int) -> int:
+    """Per-device bytes on the wire for one all-reduce of ``buffer_bytes``.
+
+    Ring all-reduce (reduce-scatter + all-gather — both the explicit
+    ``ring*`` strategies and XLA's own ``psum`` lowering) moves
+    ``2*(n-1)/n`` of the buffer through each device; n=1 moves nothing.
+    """
+    if axis_size <= 1:
+        return 0
+    return int(2 * (axis_size - 1) * buffer_bytes // axis_size)
+
 
 def register_strategy(name: str):
     def deco(fn):
@@ -202,6 +233,32 @@ class Exchanger:
             return self._fn(x, axis_name=self.axis_name, axis_size=n)
 
         return jax.tree.map(reduce_leaf, tree)
+
+    def wire_bytes(self, tree, axis_size: int) -> int:
+        """Static per-device bytes-on-wire for ONE exchange of ``tree``.
+
+        Counts exactly the leaves :meth:`exchange` reduces (inexact dtypes
+        only) at the strategy's wire dtype, times the ring traffic factor —
+        the telemetry layer's collective accounting (ISSUE 1): ``psum`` at
+        fp32 reports EXACTLY 2x the bytes of ``psum_bf16`` for the same
+        tree (the ring factor floors the per-leaf *element* count, then
+        multiplies by the wire itemsize, so compression scales the result
+        linearly).  ``tree`` may hold arrays or ``ShapeDtypeStruct``s.
+        """
+        if axis_size <= 1:
+            return 0
+        total = 0
+        for leaf in jax.tree.leaves(tree):
+            dtype = jnp.asarray(leaf).dtype if not hasattr(leaf, "dtype") \
+                else leaf.dtype
+            if not jnp.issubdtype(dtype, jnp.inexact):
+                continue
+            size = 1
+            for d in getattr(leaf, "shape", ()):
+                size *= int(d)
+            wire_elems = 2 * (axis_size - 1) * size // axis_size
+            total += wire_elems * wire_itemsize(self.strategy, dtype)
+        return total
 
     def __repr__(self):
         return f"Exchanger(strategy={self.strategy!r}, axis={self.axis_name!r})"

@@ -109,6 +109,20 @@ class GOSGDTrainer(BaseTrainer):
         # seeded in init_state so warmup()'s reset restores the full
         # deterministic schedule (push draws + ring shifts), not just params
         self._host_rng = None
+        self._hop_bytes: int | None = None
+
+    def _gossip_hop_bytes(self) -> int:
+        """Per-device fp32 bytes one gossip hop moves: the float leaves of
+        ONE worker's params (the stacked tree's leading axis is the worker
+        count) plus the scalar consensus weight, all cast to fp32 on the
+        wire by gossip_merge."""
+        if self._hop_bytes is None:
+            total = 4  # the ppermuted consensus-weight scalar
+            for leaf in jax.tree.leaves(self.params):
+                if jnp.issubdtype(leaf.dtype, jnp.inexact):
+                    total += leaf.size // self.n_workers * 4
+            self._hop_bytes = total
+        return self._hop_bytes
 
     def compile_iter_fns(self) -> None:
         local_step = make_local_step(
@@ -190,6 +204,15 @@ class GOSGDTrainer(BaseTrainer):
             jnp.int32(shift),
         )
         self.recorder.end("comm")
+        if self.telemetry is not None:
+            # gossip_merge ppermutes the full fp32 float-param set of ONE
+            # worker on every device for each of the `shift` hops (the push
+            # mask zeroes values, not traffic), so the round's per-device
+            # wire bytes are statically shift * tree_bytes; step index is
+            # pre-increment, matching the train.step span (see EASGD)
+            self.telemetry.count(
+                "exchange.wire_bytes", shift * self._gossip_hop_bytes(),
+                emit=True, step=self.iteration - 1, shift=int(shift))
 
     def warmup_exchange(self) -> None:
         if self.n_workers == 1:

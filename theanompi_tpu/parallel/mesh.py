@@ -37,6 +37,14 @@ PIPE_AXIS = "pipe"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 
+if not hasattr(jax.lax, "axis_size"):
+    # jax < 0.5 has no lax.axis_size; psum of a Python literal is computed
+    # statically inside the collective context and raises the same
+    # NameError on an unbound axis, so callers (exchanger, axis_bound)
+    # behave identically.  Installed on jax.lax so every module that spells
+    # ``lax.axis_size`` works unmodified.
+    jax.lax.axis_size = lambda axis_name: jax.lax.psum(1, axis_name)
+
 
 def force_host_devices(n: int) -> None:
     """Force ``n`` virtual CPU devices.  Must run before the first backend init.
@@ -155,9 +163,20 @@ def shard_map(f, mesh: Mesh, in_specs, out_specs, check: bool = False):
     ``check=False`` disables varying-manual-axes checking: the ring strategies
     (:mod:`theanompi_tpu.parallel.exchanger`) produce replicated outputs via
     ``ppermute`` chains the checker cannot prove replicated.
+
+    Version shim: jax promoted shard_map out of ``jax.experimental`` (and
+    renamed ``check_rep`` to ``check_vma``) — support both so the installed
+    jax decides which spelling runs.
     """
-    return jax.shard_map(
-        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check
     )
 
 

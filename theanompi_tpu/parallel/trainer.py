@@ -16,6 +16,8 @@ supplies the compiled step + parameter layout:
 
 from __future__ import annotations
 
+import time
+from contextlib import nullcontext
 from typing import Any
 
 import jax
@@ -250,7 +252,8 @@ class BaseTrainer:
                  seed: int = 0, prefetch_depth: int = 2,
                  checkpoint_dir: str | None = None, checkpoint_keep: int = 3,
                  profile_dir: str | None = None,
-                 profile_window: tuple[int, int] = (10, 20)):
+                 profile_window: tuple[int, int] = (10, 20),
+                 telemetry=None):
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh(n_data=1)
         self.n_workers = self.mesh.shape[DATA_AXIS]
@@ -277,6 +280,15 @@ class BaseTrainer:
         self.profile_dir = profile_dir
         self.profile_window = profile_window
         self._profiling = False
+        # ISSUE 1 telemetry: None means OFF — every hot-path integration
+        # below guards on it, so a disabled run makes zero telemetry calls
+        self.telemetry = telemetry
+        self.recorder.telemetry = telemetry
+        self._compiled_step_cache: tuple | None = None  # (shape key, exe)
+        self._exchange_wire_bytes_cached: int | None = None
+        self._flops_per_step: float | None = None  # None = not yet probed
+        self._peak_flops: float | None = None
+        self._last_metrics_flush: float | None = None
 
     # -- subclass surface ----------------------------------------------------
     def compile_iter_fns(self) -> None:
@@ -292,12 +304,24 @@ class BaseTrainer:
     def compiled_step(self, batch):
         """The compiled train-step executable (serves ``.cost_analysis()``
         and ``.as_text()`` for bench/roofline tooling without each caller
-        re-deriving the argument tuple)."""
+        re-deriving the argument tuple).
+
+        Memoized on the batch's shapes/dtypes (lowering is shape-based):
+        ``lower().compile()`` is a full second XLA compile, which the
+        telemetry MFU probe must not pay inside the train loop — and
+        roofline's compiled_step + compiled_step_text pair now compiles
+        once instead of twice."""
         import jax.numpy as jnp
 
+        key = jax.tree.map(lambda x: (tuple(x.shape), str(x.dtype)), batch)
+        if self._compiled_step_cache is not None \
+                and self._compiled_step_cache[0] == key:
+            return self._compiled_step_cache[1]
         args = (self.params, self.state, self.opt_state, batch,
                 jnp.float32(0.01), jnp.int32(0))
-        return self._step_fn.lower(*args).compile()
+        exe = self._step_fn.lower(*args).compile()
+        self._compiled_step_cache = (key, exe)
+        return exe
 
     def compiled_step_text(self, batch) -> str:
         """HLO text of the compiled train step (roofline/bench tooling)."""
@@ -358,6 +382,7 @@ class BaseTrainer:
             print_freq=self.recorder.print_freq,
             save_dir=self.recorder.save_dir,
             verbose=self.recorder.verbose,
+            telemetry=self.telemetry,
         )
 
     def check_divergence(self, atol: float = 0.0) -> float:
@@ -383,8 +408,11 @@ class BaseTrainer:
 
     def save_checkpoint(self, epoch: int) -> None:
         if self.checkpointer is not None:
-            self.checkpointer.save(epoch, self.iteration, self.checkpoint_trees())
-            self.recorder.save(self.checkpointer.directory)
+            with (self.telemetry.span("checkpoint.save", epoch=epoch)
+                  if self.telemetry is not None else nullcontext()):
+                self.checkpointer.save(
+                    epoch, self.iteration, self.checkpoint_trees())
+                self.recorder.save(self.checkpointer.directory)
 
     def try_resume(self) -> bool:
         """Restore the latest checkpoint if one exists; -> resumed or not.
@@ -433,10 +461,95 @@ class BaseTrainer:
         jax.profiler.stop_trace()
         self._profiling = False
 
+    # -- telemetry (ISSUE 1) -------------------------------------------------
+    def exchange_wire_bytes(self) -> int | None:
+        """Per-device ICI bytes for this rule's per-step exchange.
+
+        Static accounting (the collective is fused into the XLA step, so
+        nothing host-side can observe it): rules with a per-step exchanger
+        (BSP) report ``Exchanger.wire_bytes`` of the gradient tree.  The
+        per-device gradient buffer is the PARAM SHARD, not the global
+        param (under tensor/sequence parallelism each device reduces only
+        its slice), so leaves are sized via ``sharding.shard_shape`` —
+        and the ring spans every exchange axis, so the traffic factor uses
+        the product of the exchanger's axis sizes, not just ``data``.
+        Rules without a per-step exchanger return None; their periodic
+        exchanges account for themselves (see EASGD.post_step).
+        """
+        exch = getattr(self, "exchanger", None)
+        if exch is None or self.params is None:
+            return None
+        axes = (exch.axis_name if isinstance(exch.axis_name, tuple)
+                else (exch.axis_name,))
+        n = 1
+        for a in axes:
+            n *= self.mesh.shape.get(a, 1)
+
+        def shard_struct(x):
+            if isinstance(x, jax.Array) and x.sharding is not None:
+                return jax.ShapeDtypeStruct(
+                    x.sharding.shard_shape(x.shape), x.dtype)
+            return x
+
+        return exch.wire_bytes(jax.tree.map(shard_struct, self.params), n)
+
+    def _exchange_accounting(self) -> int:
+        """Cached per-step wire bytes; emits the one-time accounting event
+        (strategy, bytes, worker count) the first time it resolves."""
+        if self._exchange_wire_bytes_cached is None:
+            wire = self.exchange_wire_bytes()
+            self._exchange_wire_bytes_cached = 0 if wire is None else wire
+            exch = getattr(self, "exchanger", None)
+            if wire is not None and self.telemetry is not None:
+                self.telemetry.instant(
+                    "exchange.accounting",
+                    strategy=exch.strategy,
+                    bytes_per_exchange=wire,
+                    n_workers=self.n_workers,
+                )
+        return self._exchange_wire_bytes_cached
+
+    def _telemetry_flush(self, r: Recorder) -> None:
+        """Publish live training metrics at the print boundary: rates,
+        step-time percentiles, MFU, device memory high-water.
+
+        The rate window is wall time since the previous flush; callers
+        reset ``_last_metrics_flush`` to None across non-training work
+        (validation, checkpointing — see run()) so a window never absorbs
+        it and under-reports throughput.  A None window (first flush of a
+        window) publishes no rate gauges rather than a wrong number.
+        """
+        from theanompi_tpu.telemetry import metrics as tmetrics
+
+        tel = self.telemetry
+        now = time.perf_counter()
+        window_s = (now - self._last_metrics_flush
+                    if self._last_metrics_flush is not None else None)
+        self._last_metrics_flush = now
+        if window_s:
+            eps = r.print_freq * self.global_batch / window_s
+            tel.gauge("train.examples_per_sec", eps)
+            seq = self.model.config.get("seq_len")
+            if seq:
+                tel.gauge("train.tokens_per_sec", eps * seq)
+        p50 = tel.metrics.percentiles("train.step_s", (50,)).get("p50")
+        if self._flops_per_step and p50:
+            m = tmetrics.mfu(self._flops_per_step, p50, self._peak_flops)
+            if m is not None:
+                tel.gauge("train.mfu", m)
+        mem = tmetrics.device_memory_stats()
+        if mem:
+            for k, v in mem.items():
+                tel.gauge(f"device.{k}", v)
+        tel.flush_metrics(step=self.iteration, window_steps=r.print_freq)
+
     # -- iteration (reference train_iter/val_iter) ---------------------------
     def train_iter(self, batch: dict, lr: float, recorder: Recorder | None = None):
         self._profile_tick()
         r = recorder or self.recorder
+        tel = self.telemetry
+        step_t0 = time.perf_counter() if tel is not None else 0.0
+        step_idx, epoch_idx = self.iteration, self.epoch
         r.start("wait")
         # already-placed batches (prefetch path) pass through device_put free
         batch = shard_batch(self.mesh, batch, spec=self.batch_spec)
@@ -455,10 +568,43 @@ class BaseTrainer:
         # the dispatch pipeline (SURVEY.md §7 hard part 5)
         fence = metrics["cost"] if self.iteration % r.print_freq == 0 else None
         r.end("calc", fence=fence)
+        # no wrapping span here: the async rules' post_step brackets the
+        # rounds that actually exchange with recorder 'comm' segments, which
+        # the recorder already emits as spans — a per-step wrapper would
+        # write a no-op span line on every non-exchange step (tau-1 of tau)
         self.post_step()
         r.end_iteration()
         r.train_metrics(**metrics)
         r.print_train_info(self.iteration)
+        if tel is not None:
+            # same async-dispatch honesty caveat as the calc split: between
+            # print boundaries a span measures dispatch, and only the fenced
+            # boundary step reflects full device time — percentile/rate
+            # metrics below aggregate across a window, which is honest at
+            # steady state because dispatched work must drain through the
+            # donated-buffer chain
+            dur = time.perf_counter() - step_t0
+            tel.emit_span("train.step", step_t0, dur,
+                          step=step_idx, epoch=epoch_idx)
+            tel.observe("train.step_s", dur)
+            wire = self._exchange_accounting()
+            if wire:
+                tel.count("exchange.wire_bytes", wire, emit=True,
+                          step=step_idx)
+            if self._flops_per_step is None:
+                # MFU probe on the FIRST step, after its span closed: the
+                # aot lower+compile lands next to the jit compile this
+                # step already paid, instead of stalling the loop minutes
+                # later at the first print boundary; its own span keeps
+                # the cost visible rather than untracked
+                from theanompi_tpu.telemetry import metrics as tmetrics
+
+                with tel.span("telemetry.mfu_probe"):
+                    self._flops_per_step = tmetrics.step_flops_estimate(
+                        self, batch) or 0.0
+                    self._peak_flops = tmetrics.peak_flops()
+            if self.iteration % r.print_freq == 0:
+                self._telemetry_flush(r)
         return metrics
 
     def val_iter(self, batch: dict, recorder: Recorder | None = None,
@@ -484,10 +630,12 @@ class BaseTrainer:
             return {}
         accums: dict[str, list] = {}
         eval_args = self.eval_args()
-        for batch in self.model.data.val_batches(vb):
-            m = self.val_iter(batch, eval_args=eval_args)
-            for k, v in m.items():
-                accums.setdefault(k, []).append(v)
+        with (self.telemetry.span("validate", epoch=epoch)
+              if self.telemetry is not None else nullcontext()):
+            for batch in self.model.data.val_batches(vb):
+                m = self.val_iter(batch, eval_args=eval_args)
+                for k, v in m.items():
+                    accums.setdefault(k, []).append(v)
         means = {k: float(np.mean([float(x) for x in v])) for k, v in accums.items()}
         # perplexity is exp(loss): the arithmetic mean of per-batch
         # perplexities is Jensen-biased high — re-derive from the averaged
@@ -512,46 +660,58 @@ class BaseTrainer:
         from theanompi_tpu.models.data.prefetch import prefetch
 
         model = self.model
-        for epoch in range(self.epoch, model.n_epochs):
-            self.epoch = epoch
-            self.recorder.start_epoch()
-            lr = model.adjust_hyperp(epoch)
-            # para_load equivalent: read/augment/transfer overlaps compute
-            batches = prefetch(
-                model.data.train_batches(self.global_batch, epoch, seed=self.seed),
-                mesh=self.mesh,
-                depth=self.prefetch_depth,
-                spec=self.batch_spec,
-            )
-            it = iter(batches)
-            try:
-                while True:
-                    # the dequeue is the real input stall (para_load's 'wait'
-                    # — SURVEY.md §3.5); time it into the same per-iteration
-                    # wait bucket train_iter's residual shard_batch adds to,
-                    # so a starved pipeline reports wait > 0 instead of
-                    # hiding the stall in untracked loop time
-                    self.recorder.start("wait")
-                    try:
-                        batch = next(it)
-                    except StopIteration:
-                        self.recorder.cancel("wait")
-                        break
-                    self.recorder.end("wait")
-                    self.train_iter(batch, lr)
-            finally:
-                # a step failure must not leave the loader thread pinning
-                # device batches
-                close = getattr(batches, "close", None)
-                if close is not None:
-                    close()
-            val = self.validate(epoch)
-            self.save_checkpoint(epoch)
-            self.epoch = epoch + 1  # resume point: next epoch, not this one
-            if stop is not None and stop(epoch, val):
-                break
-        if self._profiling:  # window ran past the end of training
-            self._profile_stop()
+        try:
+            for epoch in range(self.epoch, model.n_epochs):
+                self.epoch = epoch
+                self.recorder.start_epoch()
+                lr = model.adjust_hyperp(epoch)
+                # para_load equivalent: read/augment/transfer overlaps compute
+                batches = prefetch(
+                    model.data.train_batches(self.global_batch, epoch,
+                                             seed=self.seed),
+                    mesh=self.mesh,
+                    depth=self.prefetch_depth,
+                    spec=self.batch_spec,
+                    telemetry=self.telemetry,
+                )
+                it = iter(batches)
+                try:
+                    while True:
+                        # the dequeue is the real input stall (para_load's
+                        # 'wait' — SURVEY.md §3.5); time it into the same
+                        # per-iteration wait bucket train_iter's residual
+                        # shard_batch adds to, so a starved pipeline reports
+                        # wait > 0 instead of hiding the stall in untracked
+                        # loop time
+                        self.recorder.start("wait")
+                        try:
+                            batch = next(it)
+                        except StopIteration:
+                            self.recorder.cancel("wait")
+                            break
+                        self.recorder.end("wait")
+                        self.train_iter(batch, lr)
+                finally:
+                    # a step failure must not leave the loader thread pinning
+                    # device batches
+                    close = getattr(batches, "close", None)
+                    if close is not None:
+                        close()
+                val = self.validate(epoch)
+                self.save_checkpoint(epoch)
+                if self.telemetry is not None:
+                    # restart the rate window: validation + checkpoint time
+                    # must not deflate the next examples/s gauge
+                    self._last_metrics_flush = None
+                self.epoch = epoch + 1  # resume point: next, not this one
+                if stop is not None and stop(epoch, val):
+                    break
+        finally:
+            # window ran past the end of training, OR an exception landed
+            # inside it — either way the device trace must be stopped and
+            # flushed, not leaked (the bounded-window contract)
+            if self._profiling:
+                self._profile_stop()
         self.recorder.save()
         model.cleanup()
         return self.recorder
@@ -590,6 +750,25 @@ class Rule:
             checkpoint_keep=self.config.get("checkpoint_keep", 3),
             profile_dir=self.config.get("profile_dir"),
             profile_window=tuple(self.config.get("profile_window", (10, 20))),
+            telemetry=self.make_telemetry(),
+        )
+
+    def make_telemetry(self):
+        """Telemetry sink from config (``telemetry_dir`` et al.), or None.
+
+        Per-rank sink files: each process of a multi-host pod writes its
+        own ``events-rank*.jsonl`` under the same directory; rank 0
+        aggregates whatever is visible at the end of :meth:`wait`.
+        """
+        directory = self.config.get("telemetry_dir")
+        if not directory:
+            return None
+        from theanompi_tpu.telemetry import Telemetry
+
+        return Telemetry(
+            directory,
+            max_bytes=self.config.get("telemetry_max_bytes", 32 * 2**20),
+            keep=self.config.get("telemetry_keep", 3),
         )
 
     def adjust_model_config(self, model_config: dict, n_workers: int) -> None:
@@ -639,4 +818,24 @@ class Rule:
         """Run training to completion (reference: join the mpirun tree)."""
         if self.trainer is None:
             raise RuntimeError("call init() before wait()")
-        return self.trainer.run()
+        tel = self.trainer.telemetry
+        try:
+            return self.trainer.run()
+        finally:
+            if tel is not None:
+                # best-effort: a full disk / dead shared mount here (often
+                # correlated with whatever killed training) must not mask
+                # the primary exception propagating out of run()
+                try:
+                    tel.close()
+                    if jax.process_index() == 0:
+                        # rank-0 aggregation: Chrome trace + cross-rank
+                        # step-skew / straggler summary over every rank
+                        # file visible under the telemetry dir
+                        from theanompi_tpu.telemetry import aggregate
+
+                        aggregate.finalize(tel.directory)
+                except Exception as e:
+                    import sys
+
+                    print(f"telemetry finalize failed: {e}", file=sys.stderr)

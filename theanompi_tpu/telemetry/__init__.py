@@ -1,0 +1,44 @@
+"""Unified telemetry layer (ISSUE 1): structured spans, collective byte
+accounting, live training metrics.
+
+Three fragments existed before this package — the Recorder's host splits,
+the bounded ``jax.profiler`` window, and per-round bench JSON — none of
+which emitted structured events.  This package is the common substrate:
+
+- :class:`~theanompi_tpu.telemetry.core.Telemetry` — per-rank JSONL event
+  sink (spans / counters / gauges, monotonic timestamps, rank+host tags,
+  bounded rotation) with a metrics registry flushed at ``print_freq``;
+- :mod:`~theanompi_tpu.telemetry.chrome_trace` — export to the Chrome
+  trace-event format so host-side spans render in Perfetto alongside the
+  ``profile_dir`` device traces;
+- :mod:`~theanompi_tpu.telemetry.aggregate` — rank-0 merge + cross-rank
+  step-skew / straggler summary for the multihost path.
+
+Everything is off by default: the trainer holds ``telemetry=None`` unless
+a sink was configured (``telemetry_dir`` rule config / ``--telemetry-dir``
+launcher flag), and every integration point guards on that, so a disabled
+run makes zero telemetry calls on the hot path.
+"""
+
+from theanompi_tpu.telemetry.core import Span, Telemetry
+from theanompi_tpu.telemetry.metrics import (
+    MetricsRegistry,
+    device_memory_stats,
+    mfu,
+    peak_flops,
+    step_flops_estimate,
+)
+from theanompi_tpu.telemetry.sink import EventSink, read_events, sink_files
+
+__all__ = [
+    "EventSink",
+    "MetricsRegistry",
+    "Span",
+    "Telemetry",
+    "device_memory_stats",
+    "mfu",
+    "peak_flops",
+    "read_events",
+    "sink_files",
+    "step_flops_estimate",
+]

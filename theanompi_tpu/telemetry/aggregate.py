@@ -1,0 +1,136 @@
+"""Rank-0 aggregation: merge per-rank sinks, summarize cross-rank skew.
+
+Run at the end of training (``Rule.wait`` calls :func:`finalize` on
+process 0).  Reads every ``events-rank*.jsonl`` visible under the
+telemetry directory — on a multi-host pod each host only sees its own
+ranks' files unless the directory is shared storage; the summary says how
+many ranks it covered, so a partial view is explicit, never silent.
+
+Cross-rank comparisons use span *durations* only (per-rank perf_counter
+epochs are not comparable):
+
+- ``step_skew``: per train step tagged on ``train.step`` spans, the
+  max-min step duration across ranks — sustained skew means a straggler,
+  since BSP's fused collective forces laggards onto the critical path;
+- ``straggler``: the rank with the highest mean step duration and its
+  ratio to the fleet mean.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import defaultdict
+
+import numpy as np
+
+from theanompi_tpu.telemetry.chrome_trace import write_chrome_trace
+from theanompi_tpu.telemetry.sink import read_events, sink_files
+
+
+def load_all_events(directory: str) -> list[dict]:
+    events: list[dict] = []
+    for p in sink_files(directory):
+        events.extend(read_events(p))
+    return events
+
+
+def summarize(directory: str) -> dict:
+    """-> the cross-rank summary dict (also what ``summary.json`` holds)."""
+    return summarize_events(load_all_events(directory))
+
+
+def summarize_events(events: list[dict]) -> dict:
+    ranks = sorted({ev.get("rank", 0) for ev in events})
+
+    # per-rank step spans: rank -> {step -> dur}
+    step_durs: dict[int, dict[int, float]] = defaultdict(dict)
+    seg_totals: dict[int, dict[str, float]] = defaultdict(
+        lambda: defaultdict(float))
+    counters: dict[int, dict[str, float]] = {}
+    for ev in events:
+        r = ev.get("rank", 0)
+        if ev.get("kind") == "span":
+            if ev.get("name") == "train.step" and "step" in ev:
+                step_durs[r][int(ev["step"])] = ev["dur"]
+            elif str(ev.get("name", "")).startswith("recorder."):
+                seg_totals[r][ev["name"].split(".", 1)[1]] += ev["dur"]
+        elif ev.get("kind") == "metrics" and "counters" in ev:
+            counters[r] = ev["counters"]  # last flush wins (cumulative)
+
+    per_rank = {}
+    for r in ranks:
+        durs = np.asarray(sorted(step_durs[r].values())) if step_durs[r] else None
+        row: dict = {"steps": len(step_durs[r])}
+        if durs is not None and durs.size:
+            row["step_ms"] = {
+                "mean": round(float(durs.mean()) * 1e3, 3),
+                "p50": round(float(np.percentile(durs, 50)) * 1e3, 3),
+                "p95": round(float(np.percentile(durs, 95)) * 1e3, 3),
+            }
+        if seg_totals.get(r):
+            row["segment_totals_s"] = {
+                k: round(v, 4) for k, v in sorted(seg_totals[r].items())
+            }
+        if r in counters:
+            row["counters"] = counters[r]
+        per_rank[str(r)] = row
+
+    out: dict = {"n_ranks": len(ranks), "per_rank": per_rank}
+
+    # skew: steps observed on every rank
+    if len(ranks) > 1:
+        common = set.intersection(
+            *(set(step_durs[r]) for r in ranks)) if all(
+            step_durs[r] for r in ranks) else set()
+        skews = [max(step_durs[r][s] for r in ranks)
+                 - min(step_durs[r][s] for r in ranks)
+                 for s in sorted(common)]
+        if skews:
+            arr = np.asarray(skews)
+            out["step_skew_ms"] = {
+                "mean": round(float(arr.mean()) * 1e3, 3),
+                "max": round(float(arr.max()) * 1e3, 3),
+                "steps_compared": int(arr.size),
+            }
+        means = {r: float(np.mean(list(step_durs[r].values())))
+                 for r in ranks if step_durs[r]}
+        if means:
+            worst = max(means, key=means.get)
+            fleet = float(np.mean(list(means.values())))
+            out["straggler"] = {
+                "rank": worst,
+                "mean_step_ms": round(means[worst] * 1e3, 3),
+                "vs_fleet_mean": round(means[worst] / fleet, 3) if fleet else None,
+            }
+    return out
+
+
+def finalize(directory: str) -> dict:
+    """Write ``trace.json`` (all ranks) + ``summary.json``; -> summary.
+
+    Events are loaded and parsed ONCE and fed to both outputs — rank
+    files can run to ``max_bytes * keep`` each, so double-parsing them
+    at end-of-run would be real time on rank 0.
+    """
+    events = load_all_events(directory)
+    write_chrome_trace(events, os.path.join(directory, "trace.json"))
+    summary = summarize_events(events)
+    with open(os.path.join(directory, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    return summary
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Aggregate a telemetry dir: Chrome trace + skew summary")
+    p.add_argument("directory")
+    args = p.parse_args(argv)
+    summary = finalize(args.directory)
+    print(json.dumps(summary, indent=1))
+
+
+if __name__ == "__main__":
+    main()

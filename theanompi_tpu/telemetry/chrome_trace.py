@@ -1,0 +1,73 @@
+"""Chrome trace-event exporter: telemetry JSONL -> Perfetto-loadable JSON.
+
+Spans become complete (``ph: "X"``) events on a per-rank process track
+(``pid`` = rank, ``tid`` = emitting thread), counters become ``ph: "C"``
+counter tracks, instants become ``ph: "i"``.  Load the output at
+ui.perfetto.dev (or chrome://tracing) next to the ``profile_dir`` device
+trace: the host-side wait/calc/comm spans line up with the XLA device
+timeline, which is the whole point — one picture of where the step went.
+
+Timestamps: trace-event ``ts`` is microseconds.  Each rank's perf_counter
+epoch is arbitrary, so ranks are normalized independently to their own
+first event — tracks align at session start, and cross-rank *duration*
+comparisons (the skew summary in ``aggregate.py``) stay exact while
+cross-rank simultaneity is approximate, as it must be without a fleet
+clock.
+"""
+
+from __future__ import annotations
+
+import json
+
+from theanompi_tpu.telemetry.sink import read_events
+
+
+def to_trace_events(events: list[dict]) -> list[dict]:
+    """Convert one or more ranks' telemetry events to trace-event dicts."""
+    t0_by_rank: dict[int, float] = {}
+    for ev in events:
+        r = ev.get("rank", 0)
+        t0_by_rank[r] = min(t0_by_rank.get(r, float("inf")), ev["ts"])
+
+    out = []
+    for ev in events:
+        rank = ev.get("rank", 0)
+        us = (ev["ts"] - t0_by_rank[rank]) * 1e6
+        kind = ev.get("kind")
+        args = {k: v for k, v in ev.items()
+                if k not in ("ts", "kind", "name", "rank", "dur", "tid")}
+        if kind == "span":
+            out.append({"ph": "X", "name": ev["name"], "pid": rank,
+                        "tid": ev.get("tid", 0), "ts": us,
+                        "dur": ev["dur"] * 1e6, "args": args})
+        elif kind in ("counter", "gauge"):
+            out.append({"ph": "C", "name": ev["name"], "pid": rank,
+                        "ts": us,
+                        "args": {ev["name"]: ev.get("total",
+                                                    ev.get("value", 0))}})
+        elif kind in ("instant", "metrics", "meta"):
+            out.append({"ph": "i", "name": ev["name"], "pid": rank,
+                        "tid": ev.get("tid", 0), "ts": us, "s": "p",
+                        "args": args})
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
+def write_chrome_trace(events: list[dict], out_path: str) -> str:
+    """Write already-loaded telemetry events as Chrome trace JSON; -> path."""
+    trace = {
+        "traceEvents": to_trace_events(events),
+        "displayTimeUnit": "ms",
+        "otherData": {"source": "theanompi_tpu.telemetry"},
+    }
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    return out_path
+
+
+def export_chrome_trace(jsonl_paths: list[str], out_path: str) -> str:
+    """Read telemetry JSONL files, write one Chrome trace JSON; -> path."""
+    events: list[dict] = []
+    for p in jsonl_paths:
+        events.extend(read_events(p))
+    return write_chrome_trace(events, out_path)

@@ -1,0 +1,156 @@
+"""Telemetry front-end: spans, instants, counters, gauges, metric flushes.
+
+Design rules (ISSUE 1):
+
+- **Off means off.**  Nothing in this module runs on the hot path unless a
+  ``Telemetry`` was explicitly constructed and handed to the trainer; the
+  integration points all guard with ``if telemetry is not None`` so a
+  disabled run makes zero telemetry calls (asserted by the tests).
+- **Honest under async dispatch.**  A span around jax work measures
+  *dispatch* unless something fences.  Spans accept the same optional
+  ``fence`` the Recorder uses: ``end(fence=x)`` blocks on the array before
+  stamping the close time.  The Recorder integration inherits its existing
+  fence discipline unchanged — the recorder blocks first, then reports the
+  segment here, so recorder spans and recorder histories are the same
+  numbers by construction.
+- **Monotonic time.**  All timestamps are ``time.perf_counter()``; the one
+  wall-clock anchor is an ISO string in the session ``meta`` event.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from datetime import datetime, timezone
+
+from theanompi_tpu.telemetry.metrics import MetricsRegistry
+from theanompi_tpu.telemetry.sink import EventSink
+
+
+class Span:
+    """Context manager stamping one complete span event on exit.
+
+    Emitted at close (Chrome ``ph: "X"`` style: start + duration), so
+    nesting in Perfetto comes from containment on the thread track — no
+    begin/end pairing to corrupt if a run dies mid-span.
+    """
+
+    __slots__ = ("_tel", "name", "tags", "t0", "_closed")
+
+    def __init__(self, tel: "Telemetry", name: str, tags: dict):
+        self._tel = tel
+        self.name = name
+        self.tags = tags
+        self.t0 = 0.0
+        self._closed = False
+
+    def __enter__(self) -> "Span":
+        self.t0 = time.perf_counter()
+        return self
+
+    def end(self, fence=None) -> float:
+        """Close + emit once; -> duration.  Idempotent, so a manual
+        fence-aware ``end(fence=x)`` inside a ``with`` block does not
+        double-emit when ``__exit__`` runs."""
+        if self._closed:
+            return 0.0
+        self._closed = True
+        if fence is not None:
+            import jax
+
+            jax.block_until_ready(fence)
+        dur = time.perf_counter() - self.t0
+        self._tel.emit_span(self.name, self.t0, dur, **self.tags)
+        return dur
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None and not self._closed:
+            self.tags = {**self.tags, "error": exc_type.__name__}
+        self.end()
+
+
+class Telemetry:
+    """One per process: owns the rank's sink and metrics registry."""
+
+    def __init__(self, directory: str, rank: int | None = None,
+                 host: str | None = None, max_bytes: int = 32 * 2**20,
+                 keep: int = 3):
+        if rank is None:
+            try:
+                import jax
+
+                rank = jax.process_index()
+            except Exception:
+                rank = 0
+        self.rank = rank
+        self.host = host or socket.gethostname()
+        self.directory = directory
+        self.sink = EventSink(directory, rank=rank, max_bytes=max_bytes,
+                              keep=keep)
+        self.metrics = MetricsRegistry()
+        self.emit("meta", "session",
+                  wall_time=datetime.now(timezone.utc).isoformat(),
+                  host=self.host, pid=os.getpid())
+
+    # -- raw emission --------------------------------------------------------
+    def emit(self, kind: str, name: str, ts: float | None = None,
+             **fields) -> None:
+        event = {"ts": time.perf_counter() if ts is None else ts,
+                 "kind": kind, "name": name, "rank": self.rank}
+        event.update(fields)
+        self.sink.emit(event)
+
+    def emit_span(self, name: str, t0: float, dur: float, **tags) -> None:
+        self.emit("span", name, ts=t0, dur=dur,
+                  tid=threading.get_ident(), **tags)
+
+    # -- user surface --------------------------------------------------------
+    def span(self, name: str, **tags) -> Span:
+        return Span(self, name, tags)
+
+    def instant(self, name: str, **fields) -> None:
+        self.emit("instant", name, **fields)
+
+    def count(self, name: str, value: float = 1.0, emit: bool = False,
+              **tags) -> None:
+        """Increment a counter.  By default accumulation-only (no I/O) —
+        totals ride the next ``flush_metrics``; ``emit=True`` also writes a
+        counter event now (used for one-per-exchange accounting)."""
+        total = self.metrics.count(name, value)
+        if emit:
+            self.emit("counter", name, value=value, total=total, **tags)
+
+    def gauge(self, name: str, value: float, **tags) -> None:
+        """Set a gauge: registry (for the snapshot) + one gauge event.
+        Gauges are set at flush boundaries, never per-iteration, so the
+        event write is off the hot path."""
+        self.metrics.gauge(name, value)
+        self.emit("gauge", name, value=float(value), **tags)
+
+    def observe(self, name: str, value: float) -> None:
+        self.metrics.observe(name, value)
+
+    def flush_metrics(self, step: int | None = None, **extra) -> None:
+        """One ``metrics`` event carrying the registry snapshot."""
+        snap = self.metrics.snapshot()
+        if step is not None:
+            snap["step"] = step
+        snap.update(extra)
+        self.emit("metrics", "metrics", **snap)
+
+    def export_chrome_trace(self, path: str | None = None) -> str:
+        """Write this rank's events as a Chrome trace-event JSON file."""
+        from theanompi_tpu.telemetry.chrome_trace import export_chrome_trace
+        from theanompi_tpu.telemetry.sink import sink_files
+
+        path = path or os.path.join(self.directory,
+                                    f"trace-rank{self.rank:05d}.json")
+        return export_chrome_trace(
+            sink_files(self.directory, rank=self.rank), path)
+
+    def close(self) -> None:
+        self.flush_metrics()
+        self.emit("meta", "session_end")
+        self.sink.close()

@@ -1,0 +1,116 @@
+"""Metrics registry: counters, gauges, histograms + training-rate helpers.
+
+The registry is host-side accumulation only — incrementing a counter or
+observing a histogram sample is a dict update, never device work or I/O.
+``snapshot()`` is called at flush boundaries (``print_freq`` in the
+trainer) and its dict rides one ``metrics`` event through the sink.
+
+MFU reuses the repo's existing FLOP accounting rather than re-deriving it:
+``step_flops_estimate`` asks XLA's cost analysis through the trainer's
+``compiled_step`` hook (the same source ``bench.py`` uses for conv nets)
+and ``peak_flops`` defers to ``bench.chip_peak_flops()`` — one table, no
+second copy of the v5e/v5p/v6 peaks.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+
+
+class MetricsRegistry:
+    """Named counters (monotonic totals), gauges (last value), histograms
+    (bounded sample windows with percentile readout)."""
+
+    def __init__(self, histogram_window: int = 1024):
+        self.counters: dict[str, float] = defaultdict(float)
+        self.gauges: dict[str, float] = {}
+        self._hists: dict[str, list] = defaultdict(list)
+        self._hist_window = histogram_window
+
+    def count(self, name: str, value: float = 1.0) -> float:
+        """Increment counter ``name``; -> new cumulative total."""
+        self.counters[name] += value
+        return self.counters[name]
+
+    def gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        h = self._hists[name]
+        h.append(float(value))
+        if len(h) > self._hist_window:
+            del h[: len(h) - self._hist_window]
+
+    def percentiles(self, name: str, qs=(50, 95, 99)) -> dict[str, float]:
+        h = self._hists.get(name)
+        if not h:
+            return {}
+        arr = np.asarray(h)
+        return {f"p{q}": float(np.percentile(arr, q)) for q in qs}
+
+    def snapshot(self) -> dict:
+        """Flush-boundary view: totals, gauges, histogram percentiles."""
+        out: dict = {}
+        if self.counters:
+            out["counters"] = dict(self.counters)
+        if self.gauges:
+            out["gauges"] = dict(self.gauges)
+        hists = {k: self.percentiles(k) for k in self._hists}
+        hists = {k: v for k, v in hists.items() if v}
+        if hists:
+            out["histograms"] = hists
+        return out
+
+
+def peak_flops() -> float | None:
+    """Chip peak FLOP/s from bench.py's table (one source of truth)."""
+    try:
+        import bench
+
+        return bench.chip_peak_flops()
+    except Exception:
+        return None
+
+
+def step_flops_estimate(trainer, batch) -> float | None:
+    """FLOPs per train step from XLA's cost analysis of the compiled step.
+
+    Same accounting (and same caveats — Pallas custom-calls count zero,
+    scan bodies count once) as ``bench.step_flops``; scaled by ``n_subb``
+    for gradient accumulation exactly as bench does.  Returns None when
+    cost analysis is unavailable; callers then simply omit MFU.
+    """
+    try:
+        analysis = trainer.compiled_step(batch).cost_analysis()
+        if isinstance(analysis, list):
+            analysis = analysis[0]
+        fl = float(analysis.get("flops", 0.0))
+        if fl <= 0:
+            return None
+        n_subb = int(trainer.model.config.get("n_subb", 1) or 1)
+        return fl * n_subb if n_subb > 1 else fl
+    except Exception:
+        return None
+
+
+def mfu(flops_per_step: float, step_time_s: float,
+        peak: float | None) -> float | None:
+    if not peak or step_time_s <= 0:
+        return None
+    return flops_per_step / step_time_s / peak
+
+
+def device_memory_stats() -> dict | None:
+    """HBM stats of local device 0 (None on backends without them — CPU)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+    return {k: int(stats[k]) for k in keep if k in stats}

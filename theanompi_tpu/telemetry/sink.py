@@ -1,0 +1,134 @@
+"""Per-rank structured event sink: JSONL with bounded file rotation.
+
+One event per line, one file per rank (``events-rank00000.jsonl``), so a
+multi-host pod writes without cross-process coordination and rank-0
+aggregation is a glob.  Rotation is size-bounded (``max_bytes`` per file,
+``keep`` rotated generations as ``.1`` .. ``.keep``) so a long training run
+cannot fill the disk — the newest events are always in the unsuffixed file.
+
+Event schema (every event, enforced by ``tests/test_telemetry.py``):
+
+=========  ==============================================================
+key        meaning
+=========  ==============================================================
+``ts``     monotonic seconds (``time.perf_counter()``) — per-process
+           epoch; comparable within a rank, NOT across ranks
+``kind``   ``meta`` | ``span`` | ``instant`` | ``counter`` | ``gauge`` |
+           ``metrics``
+``name``   dotted event name (``recorder.calc``, ``exchange.wire_bytes``)
+``rank``   ``jax.process_index()`` of the emitting process
+=========  ==============================================================
+
+Kind-specific keys: spans add ``dur`` (seconds) and ``tid`` (thread id —
+the Chrome-trace track); counters add ``value`` (increment) and ``total``
+(cumulative); gauges add ``value``; ``metrics`` events carry the registry
+snapshot at a flush boundary.  Arbitrary extra keys are tags.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+
+class EventSink:
+    """Write JSON events to a rotating per-rank file.
+
+    Thread-safe: the prefetcher's consumer and the train loop may emit
+    concurrently.  Writes are line-buffered JSON; a crashed run leaves at
+    worst one truncated final line, which the readers skip.
+
+    A telemetry directory is ONE run's artifact: constructing a sink
+    truncates this rank's live file (and drops its rotated generations),
+    because aggregation reads every event in the directory and
+    perf_counter epochs from different processes are incomparable —
+    appending a rerun to a crashed run's file would produce a garbage
+    merged timeline.  Use a fresh directory per run to keep history.
+    """
+
+    def __init__(self, directory: str, rank: int = 0,
+                 max_bytes: int = 32 * 2**20, keep: int = 3):
+        if max_bytes <= 0:
+            raise ValueError(f"max_bytes must be positive, got {max_bytes}")
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        self.rank = rank
+        self.max_bytes = max_bytes
+        self.keep = keep
+        self.path = os.path.join(directory, f"events-rank{rank:05d}.jsonl")
+        self._lock = threading.Lock()
+        for stale in list(sink_files(directory, rank=rank)):
+            if stale != self.path:
+                os.remove(stale)
+        self._f = open(self.path, "w", buffering=1)
+        self._size = 0
+
+    def emit(self, event: dict) -> None:
+        line = json.dumps(event, separators=(",", ":"), default=_jsonable)
+        with self._lock:
+            if self._f.closed:
+                return  # late emitter (prefetch thread) after close(): drop
+            self._f.write(line + "\n")
+            self._size += len(line) + 1
+            if self._size >= self.max_bytes:
+                self._rotate()
+
+    def _rotate(self) -> None:
+        self._f.close()
+        # shift generations: .keep-1 -> .keep, ..., current -> .1
+        for i in range(self.keep - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        if self.keep >= 1:
+            os.replace(self.path, f"{self.path}.1")
+        else:
+            os.remove(self.path)
+        self._f = open(self.path, "w", buffering=1)
+        self._size = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+
+def _jsonable(x):
+    """Fallback encoder: numpy/jax scalars become plain floats/ints."""
+    try:
+        return x.item()
+    except AttributeError:
+        return repr(x)
+
+
+def read_events(path: str) -> list[dict]:
+    """Parse one JSONL file, skipping a torn final line from a crashed run."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail write
+    return out
+
+
+def sink_files(directory: str, rank: int | None = None) -> list[str]:
+    """All event files under ``directory`` in chronological order
+    (oldest rotation first, live file last), optionally for one rank."""
+    import glob
+
+    pat = (f"events-rank{rank:05d}.jsonl" if rank is not None
+           else "events-rank*.jsonl")
+    live = sorted(glob.glob(os.path.join(directory, pat)))
+    out = []
+    for p in live:
+        gens = sorted(glob.glob(p + ".*"),
+                      key=lambda q: int(q.rsplit(".", 1)[1]), reverse=True)
+        out.extend(gens)
+        out.append(p)
+    return out

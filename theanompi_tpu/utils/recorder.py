@@ -24,10 +24,14 @@ SEGMENTS = ("wait", "calc", "comm")
 
 class Recorder:
     def __init__(self, print_freq: int = 40, save_dir: str | None = None,
-                 rank: int = 0, verbose: bool = True):
+                 rank: int = 0, verbose: bool = True, telemetry=None):
         self.print_freq = print_freq
         self.save_dir = save_dir
         self.verbose = verbose and rank == 0
+        # optional telemetry sink: each closed segment is also emitted as a
+        # structured span (same start/duration the histories record, so the
+        # Perfetto view and the .npy splits are one set of numbers)
+        self.telemetry = telemetry
         self._t0: dict[str, float] = {}
         self._iter_times: dict[str, float] = defaultdict(float)
         self.time_history: dict[str, list] = defaultdict(list)
@@ -50,7 +54,17 @@ class Recorder:
             # fence (the one deliberate sync point) must propagate here, not
             # at some arbitrary later sync with a misleading stack
             jax.block_until_ready(fence)
-        self._iter_times[what] += time.perf_counter() - self._t0.pop(what)
+        t0 = self._t0.pop(what, None)
+        if t0 is None:
+            raise RuntimeError(
+                f"Recorder.end({what!r}): segment was never started "
+                f"(open segments: {sorted(self._t0) or 'none'}); "
+                f"use cancel() to abandon a segment"
+            )
+        dur = time.perf_counter() - t0
+        self._iter_times[what] += dur
+        if self.telemetry is not None:
+            self.telemetry.emit_span(f"recorder.{what}", t0, dur)
 
     def cancel(self, what: str) -> None:
         """Abandon an open segment without recording it (e.g. the wait
@@ -101,6 +115,10 @@ class Recorder:
         self.val_history["epoch"].append(epoch)
         for k, v in metrics.items():
             self.val_history[k].append(float(v))
+        if self.telemetry is not None:
+            self.telemetry.instant(
+                "val_metrics", epoch=epoch,
+                **{k: float(v) for k, v in metrics.items()})
         if self.verbose:
             metric_s = " ".join(f"val_{k} {float(v):.4f}" for k, v in metrics.items())
             dur = (

@@ -65,12 +65,37 @@ def _text_bytes(text: str) -> int:
                for m in _SHAPE_RE.finditer(text))
 
 
+def _split_top_level(s: str) -> list[str]:
+    """Split on commas outside ``[]``/``{}`` (shape dims contain commas)."""
+    out, cur, depth = [], [], 0
+    for ch in s:
+        if ch in "[{":
+            depth += 1
+        elif ch in "]}":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
 def _operand_names(line: str) -> list[str]:
-    """Operand instruction names from ``op(%a, %b, ...)`` (first paren)."""
+    """Operand instruction names from the op's first paren group.
+
+    Handles both HLO operand spellings: bare names (``dot(%a, %b)``) and
+    typed operands (``dot(f32[8,4]{1,0} %a, ...)``, jax <= 0.4.x) — the
+    instruction name is always the last whitespace-separated token of each
+    top-level comma-separated operand.
+    """
     m = re.search(r"\b(?:dot|convolution)\(([^)]*)\)", line)
     if not m:
         return []
-    return [t.strip().lstrip("%") for t in m.group(1).split(",") if t.strip()]
+    return [t.strip().split()[-1].lstrip("%")
+            for t in _split_top_level(m.group(1)) if t.strip()]
 
 
 def _dot_flops(line: str, shapes: dict[str, str]) -> int:

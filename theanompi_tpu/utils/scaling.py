@@ -288,6 +288,10 @@ def main(argv=None):
     p.add_argument("--trials", type=int, default=3)
     p.add_argument("--strategy", default="psum")
     p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--set", dest="model_set", action="append", default=[],
+                   metavar="K=V",
+                   help="extra model-config entry (repeatable; same syntax "
+                   "as tmlauncher --set, e.g. --set image_size=64)")
     p.add_argument("--out", default="SCALING.json")
     p.add_argument("--virtual", type=int, default=0,
                    help="force N virtual host (CPU) devices first")
@@ -299,6 +303,9 @@ def main(argv=None):
     ns = tuple(int(x) for x in args.ns.split(","))
     cfg = {"batch_size": args.batch_size, "n_train": max(256, args.batch_size * 8),
            "n_val": 64, "n_epochs": 1, "augment": False, "verbose": False}
+    from theanompi_tpu.launcher import _parse_kv
+
+    cfg.update(_parse_kv(args.model_set))
     art = measure_scaling(args.model, cfg, ns=ns, steps=args.steps,
                           trials=args.trials, strategy=args.strategy,
                           out_path=args.out)
