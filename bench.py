@@ -153,8 +153,14 @@ def build_trainer(model_name: str, platform: str):
     model = cls(cfg)
     mesh = make_mesh(n_data=1, devices=jax.devices()[:1])
     # huge print_freq: train_iter fences on metrics at print boundaries,
-    # which would inject the per-step-sync artifact mid-trial
+    # which would inject the per-step-sync artifact mid-trial.
+    # BENCH_EXCH / BENCH_EXCH_BUCKET_MB select the exchange strategy and
+    # fused-bucket size (single-chip runs exchange nothing, but the knobs
+    # make multi-chip bench invocations strategy-comparable)
     trainer = BSPTrainer(model, mesh=mesh,
+                         exch_strategy=os.environ.get("BENCH_EXCH", "psum"),
+                         exch_bucket_mb=float(
+                             os.environ.get("BENCH_EXCH_BUCKET_MB", "4")),
                          recorder=Recorder(verbose=False, print_freq=10**9))
     trainer.compile_iter_fns()
     trainer.init_state()

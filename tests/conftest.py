@@ -26,6 +26,16 @@ def mesh8():
 
 
 @pytest.fixture(scope="session")
+def mesh4():
+    """4 data-parallel devices — the ISSUE 2 acceptance mesh; ring-strategy
+    compiles unroll 2(n-1) hops, so exchange tests that don't need 8 workers
+    run here at less than half the XLA compile cost."""
+    from theanompi_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(n_data=4, devices=jax.devices()[:4])
+
+
+@pytest.fixture(scope="session")
 def mesh4x2():
     from theanompi_tpu.parallel.mesh import make_mesh
 

@@ -34,6 +34,39 @@ def _run(devices, config=None, model_config=None):
     )
     return rule.wait()
 
+def test_scalar_hoisting_caches_lr_and_carries_step():
+    """ISSUE 2 satellite: the per-step jnp.float32(lr)/jnp.int32(step)
+    host->device transfers are hoisted — the placed lr is reused until the
+    schedule changes it, and the step counter rides the compiled step's
+    `_next_step` output instead of re-crossing the host boundary."""
+    import jax
+
+    from theanompi_tpu.parallel.bsp import BSPTrainer
+    from theanompi_tpu.models.wide_resnet import WideResNet
+    from theanompi_tpu.parallel.mesh import make_mesh
+    from theanompi_tpu.utils.recorder import Recorder
+
+    model = WideResNet({**TINY, "batch_size": 2, "image_size": 8,
+                        "n_train": 16, "n_epochs": 1})
+    t = BSPTrainer(model, mesh=make_mesh(n_data=1, devices=jax.devices()[:1]),
+                   recorder=Recorder(verbose=False, print_freq=10**9))
+    t.compile_iter_fns()
+    t.init_state()
+    batches = list(model.data.train_batches(t.global_batch, 0, seed=0))
+    t.train_iter(batches[0], lr=0.05)
+    lr_dev = t._lr_dev
+    assert t._step_dev is not None and int(t._step_dev) == 1
+    t.train_iter(batches[1], lr=0.05)
+    assert t._lr_dev is lr_dev, "same lr must reuse the placed scalar"
+    assert int(t._step_dev) == 2, "step must carry as a device scalar"
+    t.train_iter(batches[2], lr=0.01)
+    assert t._lr_dev is not lr_dev, "schedule change must re-place the lr"
+    # external counter changes (reset/resume) invalidate the carried step
+    t.reset_iter()
+    t.train_iter(batches[3], lr=0.01)
+    assert int(t._step_dev) == 1
+
+
 @pytest.mark.slow
 def test_bsp_8worker_learns():
     rec = _run(devices=8)

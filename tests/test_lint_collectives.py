@@ -1,0 +1,77 @@
+"""HLO collective-count lint (ISSUE 2): bucketing regressions fail fast.
+
+The bucketed exchange's whole point is O(buckets) collectives instead of
+O(leaves).  That property is invisible to numeric tests (the mean is the
+mean either way) and unmeasurable without hardware — but it IS statically
+checkable: compile the BSP step on the CPU mesh and count ``all-reduce``
+op definitions in the HLO.  A refactor that silently falls back to
+leaf-wise collectives (or un-fuses the metrics/state pmeans) breaks this
+file long before anyone profiles a TPU.
+"""
+
+import jax
+
+from theanompi_tpu.models.wide_resnet import WideResNet
+from theanompi_tpu.parallel.bsp import BSPTrainer
+from theanompi_tpu.parallel.mesh import make_mesh
+from theanompi_tpu.telemetry.metrics import hlo_collective_counts
+from theanompi_tpu.utils.helper_funcs import shard_batch
+from theanompi_tpu.utils.recorder import Recorder
+
+# depth 16 -> 43 param leaves: comfortably past the >=30-leaf bar the
+# acceptance criterion sets, still tiny enough to compile in seconds
+WIDE_CFG = {
+    "depth": 16, "widen": 1, "batch_size": 2, "image_size": 8,
+    "n_train": 32, "n_val": 16, "n_epochs": 1, "precision": "fp32",
+    "augment": False, "verbose": False,
+}
+
+
+def _compiled_counts(strategy):
+    model = WideResNet(dict(WIDE_CFG))
+    mesh = make_mesh(n_data=4, devices=jax.devices()[:4])
+    t = BSPTrainer(model, mesh=mesh, exch_strategy=strategy,
+                   recorder=Recorder(verbose=False, print_freq=10**9))
+    t.compile_iter_fns()
+    t.init_state()
+    batch = shard_batch(
+        mesh, next(iter(model.data.train_batches(t.global_batch, 0, seed=0))),
+        spec=t.batch_spec)
+    n_leaves = len(jax.tree.leaves(t.params))
+    return hlo_collective_counts(t.compiled_step_text(batch)), n_leaves
+
+
+def test_bucketed_step_compiles_to_few_allreduces():
+    """Acceptance: >=30-leaf model + psum_bucket -> <=4 all-reduce HLO ops
+    (grad bucket + fused metrics pmean + fused state pmean); the leaf-wise
+    psum baseline compiles to one all-reduce per gradient leaf and MUST
+    count higher — if it stops doing so, XLA started combining leaf-wise
+    collectives itself and this lint (plus the bucket machinery's perf
+    rationale) needs re-evaluating."""
+    bucketed, n_leaves = _compiled_counts("psum_bucket")
+    assert n_leaves >= 30, f"model too small to prove bucketing: {n_leaves}"
+    assert bucketed.get("all-reduce", 0) <= 4, bucketed
+
+    leafwise, _ = _compiled_counts("psum")
+    assert leafwise["all-reduce"] > 4, leafwise
+    assert leafwise["all-reduce"] > bucketed.get("all-reduce", 0), (
+        leafwise, bucketed)
+    # one all-reduce per grad leaf, plus the two fused pmeans
+    assert leafwise["all-reduce"] >= n_leaves, (leafwise, n_leaves)
+
+
+def test_hlo_collective_counts_parser():
+    """Parser unit: defs count, -start/-done pairs count once, operand
+    references (no parens) and metadata mentions don't."""
+    text = """
+  %all-reduce.1 = f32[16]{0} all-reduce(f32[16]{0} %p), to_apply=%add
+  %ars = (f32[4]{0}, f32[4]{0}) all-reduce-start(f32[4]{0} %q)
+  %ard = f32[4]{0} all-reduce-done((f32[4]{0}, f32[4]{0}) %ars)
+  %rs = f32[4]{0} reduce-scatter(f32[16]{0} %all-reduce.1), dimensions={0}
+  %ag = f32[16]{0} all-gather(f32[4]{0} %rs), dimensions={0}
+  %cp = f32[4]{0} collective-permute(f32[4]{0} %x)
+  %y = f32[4]{0} add(f32[4]{0} %cp, f32[4]{0} %cp)
+"""
+    counts = hlo_collective_counts(text)
+    assert counts == {"all-reduce": 2, "reduce-scatter": 1,
+                      "all-gather": 1, "collective-permute": 1}

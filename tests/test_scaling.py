@@ -11,7 +11,11 @@ import json
 import numpy as np
 import pytest
 
-from theanompi_tpu.utils.scaling import _have_xplane_protos, measure_scaling
+from theanompi_tpu.utils.scaling import (
+    _have_xplane_protos,
+    exchange_microbench,
+    measure_scaling,
+)
 
 # the profiler-backed comm-share tests parse xplanes via tensorflow's
 # protos; on a JAX-only install they skip (the harness itself records
@@ -45,6 +49,38 @@ def test_scaling_harness_artifact(tmp_path):
     # artifact round-trips (per_n keys become strings in json)
     loaded = json.loads(out.read_text())
     assert loaded["per_n"]["2"]["imgs_per_sec"] > 0
+
+
+def test_exchange_microbench_artifact(tmp_path):
+    """ISSUE 2: the exchange microbenchmark emits, per strategy, HLO
+    collective counts + static wire bytes that encode the tentpole's
+    claims — fewer fused all-reduces, exact compression ratios, and
+    zero1's reduce-scatter/all-gather pair."""
+    out = tmp_path / "exchange.json"
+    art = exchange_microbench(
+        "wide_resnet", dict(TINY, batch_size=4, n_train=32),
+        n=4, strategies=("psum", "zero1"),
+        steps=2, out_path=str(out),
+    )
+    rows = art["per_strategy"]
+    # (the psum_bucket-vs-psum all-reduce collapse is locked by
+    # tests/test_lint_collectives.py on the same counter)
+    assert rows["zero1"]["wire_bytes_per_step"] == \
+        rows["psum"]["wire_bytes_per_step"]
+    # zero1 lowers its grad path to reduce-scatter + all-gather; the
+    # remaining all-reduces (sync-BN statistics + fused pmeans — _build
+    # runs the production multi-worker config, sync-BN on) must come in
+    # strictly below leaf-wise psum's, which carries those PLUS one
+    # all-reduce per gradient leaf
+    z = rows["zero1"]["collectives"]
+    assert z.get("reduce-scatter", 0) >= 1 and z.get("all-gather", 0) >= 1
+    assert z.get("all-reduce", 0) < rows["psum"]["collectives"]["all-reduce"]
+    for row in rows.values():
+        assert row["step_ms"] > 0
+    assert rows["zero1"]["buckets"]["n_buckets"] >= 1
+    # artifact round-trips
+    loaded = json.loads(out.read_text())
+    assert loaded["per_strategy"]["psum"]["collectives"]["all-reduce"] > 0
 
 
 def test_none_strategy_skips_exchange(mesh8):

@@ -25,6 +25,7 @@ from theanompi_tpu.ops import layers as L
 from theanompi_tpu.ops.initializers import normal
 from theanompi_tpu.ops.losses import sigmoid_binary_cross_entropy
 from theanompi_tpu.ops.opt import Adam, RMSProp
+from theanompi_tpu.parallel.exchanger import EXCHANGE_RNG_TAG
 from theanompi_tpu.parallel.mesh import DATA_AXIS, replica_rng
 
 
@@ -170,11 +171,16 @@ class DCGAN(Model):
         wgan = cfg["wgan"]
         clip = cfg["clip"]
 
-        def exchange(g):
-            return exchanger.exchange(g) if exchanger is not None else g
+        def exchange(g, key):
+            # the per-step key matters: ring_int8 seeds its stochastic
+            # rounding from it — a fixed fallback key would repeat the same
+            # per-element rounding direction every step (systematic drift)
+            return exchanger.exchange(g, rng=key) if exchanger is not None \
+                else g
 
         def inner(params, state, opt_state, batch, lr, step):
             rng = replica_rng(jax.random.fold_in(base_key, step), DATA_AXIS)
+            exch_key = jax.random.fold_in(rng, EXCHANGE_RNG_TAG)
             kz1, kz2 = jax.random.split(rng)
             real = batch["x"].astype(self.precision.compute_dtype)
             b = real.shape[0]
@@ -194,7 +200,7 @@ class DCGAN(Model):
             (d_loss, disc_state), d_grads = jax.value_and_grad(
                 d_obj, has_aux=True
             )(params["disc"])
-            d_grads = exchange(d_grads)
+            d_grads = exchange(d_grads, jax.random.fold_in(exch_key, 0))
             new_disc, new_dopt = opt.update(
                 d_grads, opt_state["disc"], params["disc"],
                 lr * cfg["disc_lr_scale"]
@@ -217,7 +223,7 @@ class DCGAN(Model):
             (g_loss, gen_state2), g_grads = jax.value_and_grad(
                 g_obj, has_aux=True
             )(params["gen"])
-            g_grads = exchange(g_grads)
+            g_grads = exchange(g_grads, jax.random.fold_in(exch_key, 1))
             new_gen, new_gopt = opt.update(
                 g_grads, opt_state["gen"], params["gen"], lr
             )

@@ -80,6 +80,32 @@ def clip_by_global_norm(grads, max_norm: float, param_specs=None):
     return _tmap(lambda g: (g * scale).astype(g.dtype), grads)
 
 
+def sharded_update(opt, grads, opt_state, params, lr, axis_name=None):
+    """ZeRO-1 shard-local optimizer update (the exchanger's ``zero1`` entry
+    point): same math as ``opt.update`` on the full tree, applied to the
+    1/n shard each device owns of the flattened bucket buffers.
+
+    Weight decay and every update rule here (SGD/momentum/Nesterov, Adam,
+    RMSProp) are elementwise, so they shard transparently.  Gradient
+    clipping's global norm is the one cross-shard quantity: the shards
+    partition the gradient tree exactly (no element appears twice), so the
+    psum of per-shard squared norms over ``axis_name`` IS the global norm.
+    Clipping is applied here and then disabled on the inner optimizer so it
+    is never double-applied.
+    """
+    if opt.grad_clip:
+        sq = global_sq_norm(grads)
+        if axis_name is not None:
+            axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+            for a in axes:
+                sq = jax.lax.psum(sq, a)
+        norm = jnp.sqrt(sq)
+        scale = jnp.minimum(1.0, opt.grad_clip / jnp.maximum(norm, 1e-12))
+        grads = _tmap(lambda g: (g * scale).astype(g.dtype), grads)
+        opt = dataclasses.replace(opt, grad_clip=None)
+    return opt.update(grads, opt_state, params, lr)
+
+
 class Optimizer:
     #: defaults for the _preprocess contract; subclasses carry the fields
     grad_clip: float | None = None

@@ -39,12 +39,16 @@ class BSPTrainer(BaseTrainer):
     pure functions.
     """
 
-    def __init__(self, model, mesh=None, exch_strategy: str = "psum", **kwargs):
+    def __init__(self, model, mesh=None, exch_strategy: str = "psum",
+                 exch_bucket_mb: float = 4.0, **kwargs):
         super().__init__(model, mesh=mesh, **kwargs)
         # reduce over every axis the batch is sharded on (data; +seq for
-        # sequence-parallel models whose grads are per-shard partials)
+        # sequence-parallel models whose grads are per-shard partials);
+        # exch_bucket_mb caps the fused-bucket payload of the *_bucket /
+        # ring_int8 / zero1 strategies (see exchanger module docstring)
         self.exchanger = Exchanger(
-            strategy=exch_strategy, axis_name=model.grad_reduce_axes()
+            strategy=exch_strategy, axis_name=model.grad_reduce_axes(),
+            bucket_bytes=int(float(exch_bucket_mb) * 2**20),
         )
         self.batch_spec = model.batch_partition()
 
@@ -57,7 +61,28 @@ class BSPTrainer(BaseTrainer):
         param_t, state_t = shapes
         pspecs = self.model.param_specs(param_t)
         sspecs = self.model.state_specs(state_t)
-        ospecs = self.model.opt_state_specs(self.optimizer, pspecs)
+        if self.exchanger.fuses_update:
+            # zero1 stores opt state as flat bucket buffers sharded over
+            # the exchange axis — only coherent when params are replicated
+            # (pure data parallelism): a tensor/pipeline-sharded leaf holds
+            # a different slice per model shard and cannot be packed into
+            # one replicated flat bucket.  Specs naming size-1 mesh axes
+            # are effectively replicated and fine.
+            for spec in jax.tree.leaves(pspecs):
+                for entry in (spec or ()):
+                    axes = entry if isinstance(entry, tuple) else (entry,)
+                    for ax in axes:
+                        if ax and self.mesh.shape.get(ax, 1) > 1:
+                            raise ValueError(
+                                f"exch_strategy 'zero1' requires replicated "
+                                f"(data-parallel) params; leaf spec {spec} "
+                                f"shards over mesh axis {ax!r} (size "
+                                f"{self.mesh.shape[ax]})"
+                            )
+            ospecs = self.exchanger.zero1_opt_state_specs(
+                self.optimizer, param_t, self._exchange_axis_size())
+        else:
+            ospecs = self.model.opt_state_specs(self.optimizer, pspecs)
         return pspecs, sspecs, ospecs
 
     # -- compilation ---------------------------------------------------------
@@ -77,7 +102,9 @@ class BSPTrainer(BaseTrainer):
                 in_specs=(pspecs, sspecs, ospecs, self.batch_spec, P(), P()),
                 out_specs=(pspecs, sspecs, ospecs, P()),
             ),
-            donate_argnums=(0, 1, 2),
+            # 5 is the device step counter: donated so the returned
+            # `_next_step` scalar aliases it (trainer scalar-hoisting)
+            donate_argnums=(0, 1, 2, 5),
         )
         self._eval_fn = jax.jit(
             shard_map(
@@ -93,9 +120,13 @@ class BSPTrainer(BaseTrainer):
         pspecs, sspecs, ospecs = self._spec_trees()
         self.params = place(self.mesh, params, pspecs)
         self.state = place(self.mesh, state, sspecs)
-        self.opt_state = place(
-            self.mesh, self.model.init_opt_state(self.optimizer, params), ospecs
-        )
+        if self.exchanger.fuses_update:
+            # ZeRO-1: flat bucket buffers, sharded 1/n per device by ospecs
+            opt_state = self.exchanger.zero1_init_opt_state(
+                self.optimizer, params, self._exchange_axis_size())
+        else:
+            opt_state = self.model.init_opt_state(self.optimizer, params)
+        self.opt_state = place(self.mesh, opt_state, ospecs)
 
 
 class BSP(Rule):
@@ -111,5 +142,6 @@ class BSP(Rule):
             model,
             mesh=mesh,
             exch_strategy=self.config.get("exch_strategy", "psum"),
+            exch_bucket_mb=self.config.get("exch_bucket_mb", 4.0),
             **self.common_trainer_kwargs(recorder),
         )

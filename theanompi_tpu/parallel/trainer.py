@@ -37,15 +37,20 @@ from theanompi_tpu.utils.helper_funcs import import_model, shard_batch
 from theanompi_tpu.utils.recorder import Recorder
 
 
+from theanompi_tpu.parallel.exchanger import (  # noqa: E402
+    EXCHANGE_RNG_TAG as _EXCH_RNG_TAG,
+    fused_pmean,
+)
+
+
 def pmean_floats(tree, axis_name):
-    """pmean every inexact leaf; pass ints (counters etc.) through."""
+    """pmean every inexact leaf; pass ints (counters etc.) through.
 
-    def f(x):
-        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
-            return jax.lax.pmean(x, axis_name)
-        return x
-
-    return jax.tree.map(f, tree)
+    Fused: one collective per dtype instead of one per leaf (a BN-state
+    tree of 16 running-stat buffers costs ONE all-reduce) — part of the
+    bucketed-exchange HLO budget ``tests/test_lint_collectives.py`` locks.
+    """
+    return fused_pmean(tree, axis_name)
 
 
 def unstack(tree):
@@ -93,6 +98,11 @@ def make_local_step(model, opt, base_key, exchanger=None, stacked=False,
             f"n_subb={n_subb} requires the standard grad step; "
             f"{type(model).__name__} supplies make_custom_step"
         )
+    if inner is not None and exchanger is not None and exchanger.fuses_update:
+        raise ValueError(
+            f"exch_strategy 'zero1' requires the standard grad step; "
+            f"{type(model).__name__} supplies make_custom_step"
+        )
 
     def local_step(params, state, opt_state, batch, lr, step):
         if stacked:
@@ -121,11 +131,23 @@ def make_local_step(model, opt, base_key, exchanger=None, stacked=False,
                 new_state, metrics, grads = _accumulated_grads(
                     model, params, state, batch, rng, n_subb
                 )
-            if exchanger is not None:
-                grads = exchanger.exchange(grads)
-            new_params, new_opt_state = opt.update(
-                grads, opt_state, params, lr, param_specs=param_specs
-            )
+            if exchanger is not None and exchanger.fuses_update:
+                # zero1: the exchange IS the update — reduce-scatter grad
+                # buckets, shard-local optimizer step, all-gather params
+                # (opt_state lives in the exchanger's sharded bucket layout)
+                new_params, new_opt_state = exchanger.exchange_and_update(
+                    grads, opt_state, params, lr, opt,
+                    rng=jax.random.fold_in(rng, _EXCH_RNG_TAG),
+                )
+            else:
+                if exchanger is not None:
+                    # a distinct stream from dropout's: ring_int8 seeds its
+                    # stochastic rounding from this key
+                    grads = exchanger.exchange(
+                        grads, rng=jax.random.fold_in(rng, _EXCH_RNG_TAG))
+                new_params, new_opt_state = opt.update(
+                    grads, opt_state, params, lr, param_specs=param_specs
+                )
         if stacked:
             return (
                 restack(new_params),
@@ -138,6 +160,13 @@ def make_local_step(model, opt, base_key, exchanger=None, stacked=False,
         # keep non-learned state consistent across replicas (already
         # identical under sync-BN; pmean repairs drift otherwise)
         new_state = pmean_floats(new_state, axes)
+        if isinstance(metrics, dict):
+            # the donated-device-step contract: train_iter pops this and
+            # feeds it back as the next step argument, so the counter never
+            # re-crosses the host boundary (one H2D transfer per run, not
+            # per step)
+            metrics = dict(metrics)
+            metrics["_next_step"] = step + jnp.int32(1)
         return new_params, new_state, new_opt_state, metrics
 
     return local_step
@@ -286,6 +315,14 @@ class BaseTrainer:
         self.recorder.telemetry = telemetry
         self._compiled_step_cache: tuple | None = None  # (shape key, exe)
         self._exchange_wire_bytes_cached: int | None = None
+        # per-step host->device scalar hoisting (ISSUE 2 satellite): the
+        # placed lr is cached until the schedule changes it, and the step
+        # counter round-trips as a device scalar (the step returns
+        # `_next_step`, fed back as the next call's argument)
+        self._lr_dev = None
+        self._lr_host: float | None = None
+        self._step_dev = None
+        self._step_dev_iter: int = -1
         self._flops_per_step: float | None = None  # None = not yet probed
         self._peak_flops: float | None = None
         self._last_metrics_flush: float | None = None
@@ -479,11 +516,22 @@ class BaseTrainer:
         exch = getattr(self, "exchanger", None)
         if exch is None or self.params is None:
             return None
+        return exch.wire_bytes(self._shard_param_structs(),
+                               self._exchange_axis_size())
+
+    def _exchange_axis_size(self) -> int:
+        exch = getattr(self, "exchanger", None)
+        if exch is None:
+            return 1
         axes = (exch.axis_name if isinstance(exch.axis_name, tuple)
                 else (exch.axis_name,))
         n = 1
         for a in axes:
             n *= self.mesh.shape.get(a, 1)
+        return n
+
+    def _shard_param_structs(self):
+        """The per-device param-shard shapes the exchange actually moves."""
 
         def shard_struct(x):
             if isinstance(x, jax.Array) and x.sharding is not None:
@@ -491,7 +539,7 @@ class BaseTrainer:
                     x.sharding.shard_shape(x.shape), x.dtype)
             return x
 
-        return exch.wire_bytes(jax.tree.map(shard_struct, self.params), n)
+        return jax.tree.map(shard_struct, self.params)
 
     def _exchange_accounting(self) -> int:
         """Cached per-step wire bytes; emits the one-time accounting event
@@ -501,11 +549,15 @@ class BaseTrainer:
             self._exchange_wire_bytes_cached = 0 if wire is None else wire
             exch = getattr(self, "exchanger", None)
             if wire is not None and self.telemetry is not None:
+                extra = exch.bucket_summary(
+                    self._shard_param_structs(),
+                    self._exchange_axis_size()) or {}
                 self.telemetry.instant(
                     "exchange.accounting",
                     strategy=exch.strategy,
                     bytes_per_exchange=wire,
                     n_workers=self.n_workers,
+                    **extra,
                 )
         return self._exchange_wire_bytes_cached
 
@@ -555,15 +607,31 @@ class BaseTrainer:
         batch = shard_batch(self.mesh, batch, spec=self.batch_spec)
         r.end("wait")
         r.start("calc")
+        # scalar-hoisting (ISSUE 2 satellite): jnp.float32(lr)/jnp.int32(i)
+        # here were one host->device transfer EACH per step; the lr is
+        # placed once per schedule change and the step counter is carried
+        # as a device scalar threaded through the step's `_next_step`
+        lr_f = float(lr)
+        if self._lr_dev is None or self._lr_host != lr_f:
+            self._lr_dev = jnp.float32(lr_f)
+            self._lr_host = lr_f
+        if self._step_dev is None or self._step_dev_iter != self.iteration:
+            self._step_dev = jnp.int32(self.iteration)
         self.params, self.state, self.opt_state, metrics = self._step_fn(
             self.params,
             self.state,
             self.opt_state,
             batch,
-            jnp.float32(lr),
-            jnp.int32(self.iteration),
+            self._lr_dev,
+            self._step_dev,
         )
         self.iteration += 1
+        nxt = (metrics.pop("_next_step", None)
+               if isinstance(metrics, dict) else None)
+        if nxt is not None and getattr(nxt, "ndim", None) == 0:
+            self._step_dev, self._step_dev_iter = nxt, self.iteration
+        else:  # stacked/custom metrics carry no counter: re-place next call
+            self._step_dev = None
         # fence only at print boundaries: per-iter blocking would serialize
         # the dispatch pipeline (SURVEY.md §7 hard part 5)
         fence = metrics["cost"] if self.iteration % r.print_freq == 0 else None
@@ -635,8 +703,15 @@ class BaseTrainer:
             for batch in self.model.data.val_batches(vb):
                 m = self.val_iter(batch, eval_args=eval_args)
                 for k, v in m.items():
+                    # device arrays accumulate WITHOUT float(): a per-batch
+                    # float() forced a device sync per metric per batch,
+                    # serializing the eval dispatch pipeline (ISSUE 2
+                    # satellite) — the single pull happens after the loop
                     accums.setdefault(k, []).append(v)
-        means = {k: float(np.mean([float(x) for x in v])) for k, v in accums.items()}
+        means = {
+            k: float(np.asarray(jnp.stack(v)).mean(dtype=np.float64))
+            for k, v in accums.items()
+        }
         # perplexity is exp(loss): the arithmetic mean of per-batch
         # perplexities is Jensen-biased high — re-derive from the averaged
         # cost (same fix the micro-batch accumulation path applies)

@@ -14,9 +14,41 @@ second copy of the v5e/v5p/v6 peaks.
 
 from __future__ import annotations
 
+import re
 from collections import defaultdict
 
 import numpy as np
+
+#: HLO collective op kinds, as spelled in compiled-module text
+COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                  "collective-permute", "all-to-all")
+
+_COLL_DEF_RE = re.compile(
+    r"\b(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(-start|-done)?\(")
+
+
+def hlo_collective_counts(hlo_text: str) -> dict[str, int]:
+    """Count collective-op definitions per kind in compiled HLO text.
+
+    The static cross-check for bucketed exchange (ISSUE 2): a fused-bucket
+    step must compile to O(buckets) ``all-reduce`` ops, not O(leaves) — and
+    ``zero1`` must show its ``reduce-scatter``/``all-gather`` pair.  Works
+    on any backend, so CPU-mesh tests lint collective counts without TPU
+    hardware (``tests/test_lint_collectives.py``); the exchange
+    microbenchmark (``utils/scaling.py --exchange-bench``) reports the same
+    numbers per strategy.  Async ``-start``/``-done`` pairs count once;
+    operand references never carry parens, so only definitions match.
+    """
+    counts: dict[str, int] = {}
+    for line in hlo_text.splitlines():
+        if " = " not in line:
+            continue
+        for m in _COLL_DEF_RE.finditer(line):
+            if m.group(2) == "-done":
+                continue
+            counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+    return counts
 
 
 class MetricsRegistry:

@@ -23,6 +23,10 @@ multi-chip slice (the numbers that count).  CLI::
 
     python -m theanompi_tpu.utils.scaling --ns 1,2,4,8 --out SCALING.json
     # no multi-chip hardware? add --virtual 8 (forces host devices)
+    # exchange-strategy microbenchmark (HLO collective counts + static
+    # wire bytes per strategy — exact on any backend):
+    python -m theanompi_tpu.utils.scaling --exchange-bench --ns 4 \
+        --strategies psum,psum_bucket,ring_int8,zero1 --out EXCHANGE.json
 """
 
 from __future__ import annotations
@@ -147,7 +151,8 @@ def measure_comm_share(trainer, batches, steps: int = 6, lr: float = 0.01):
     return (comm_s / total_s if total_s else 0.0), comm_s, total_s
 
 
-def _build(model_name: str, model_config: dict, n: int, strategy: str):
+def _build(model_name: str, model_config: dict, n: int, strategy: str,
+           bucket_mb: float = 4.0):
     import jax
 
     from theanompi_tpu.parallel.bsp import BSPTrainer
@@ -165,6 +170,7 @@ def _build(model_name: str, model_config: dict, n: int, strategy: str):
     model = model_cls(cfg)
     mesh = make_mesh(n_data=n, devices=jax.devices()[:n])
     trainer = BSPTrainer(model, mesh=mesh, exch_strategy=strategy,
+                         exch_bucket_mb=bucket_mb,
                          recorder=Recorder(verbose=False, print_freq=10**9))
     trainer.compile_iter_fns()
     trainer.init_state()
@@ -280,6 +286,78 @@ def measure_scaling(
     return artifact
 
 
+#: the exchange microbenchmark's default strategy sweep
+EXCHANGE_BENCH_STRATEGIES = (
+    "psum", "psum_bf16", "psum_bucket", "psum_bf16_bucket",
+    "ring", "ring_bucket", "ring_int8", "zero1",
+)
+
+
+def exchange_microbench(
+    model_name: str = "wide_resnet",
+    model_config: dict | None = None,
+    n: int = 4,
+    strategies=EXCHANGE_BENCH_STRATEGIES,
+    steps: int = 4,
+    trials: int = 1,
+    bucket_mb: float = 4.0,
+    out_path: str | None = None,
+) -> dict:
+    """Exchange-strategy microbenchmark on an ``n``-device mesh.
+
+    For each strategy: HLO-derived collective counts of the compiled train
+    step (``telemetry.metrics.hlo_collective_counts`` — the honest
+    launch-overhead proxy when the collective is fused into one XLA
+    program), static per-step wire bytes (``Exchanger.wire_bytes``), bucket
+    layout, and pipelined step time.  On the CPU fake mesh the *times*
+    only bound framework overhead; the collective counts and byte
+    accounting are exact on any backend — that is the point: bucketing
+    regressions show up as op-count jumps with no TPU attached.
+    """
+    import jax
+
+    from theanompi_tpu.telemetry.metrics import hlo_collective_counts
+    from theanompi_tpu.utils.benchlib import best_trial
+
+    model_config = model_config or {
+        "batch_size": 8, "n_train": 64, "n_val": 16,
+        "n_epochs": 1, "augment": False, "verbose": False,
+    }
+    per_strategy = {}
+    for strategy in strategies:
+        trainer, batches = _build(model_name, model_config, n, strategy,
+                                  bucket_mb=bucket_mb)
+        m = trainer.train_iter(batches[0], lr=0.01)  # compile + warm
+        float(m["cost"])
+        counts = hlo_collective_counts(trainer.compiled_step_text(batches[0]))
+        (dt, _, _), _ = best_trial(trainer, batches, steps, trials)
+        row = {
+            "collectives": counts,
+            "collective_ops_total": sum(counts.values()),
+            "wire_bytes_per_step": trainer.exchange_wire_bytes(),
+            "step_ms": round(dt / steps * 1e3, 3),
+        }
+        buckets = trainer.exchanger.bucket_summary(
+            trainer._shard_param_structs(), n)
+        if buckets:
+            row["buckets"] = buckets
+        per_strategy[strategy] = row
+    artifact = {
+        "model": model_name,
+        "n": int(n),
+        "platform": jax.devices()[0].platform,
+        "steps": steps,
+        "bucket_mb": bucket_mb,
+        "per_strategy": per_strategy,
+        "note": ("collective counts + wire bytes are static/exact on any "
+                 "backend; step_ms is only meaningful on real chips"),
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+    return artifact
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--model", default="wide_resnet")
@@ -295,6 +373,15 @@ def main(argv=None):
     p.add_argument("--out", default="SCALING.json")
     p.add_argument("--virtual", type=int, default=0,
                    help="force N virtual host (CPU) devices first")
+    p.add_argument("--exchange-bench", action="store_true",
+                   help="run the exchange-strategy microbenchmark instead "
+                   "of the scaling ladder (HLO collective counts + static "
+                   "wire bytes + step time per strategy)")
+    p.add_argument("--strategies",
+                   default=",".join(EXCHANGE_BENCH_STRATEGIES),
+                   help="comma list for --exchange-bench")
+    p.add_argument("--bucket-mb", type=float, default=4.0,
+                   help="fused-bucket size for the bucketed strategies")
     args = p.parse_args(argv)
     if args.virtual:
         from theanompi_tpu.parallel.mesh import force_host_devices
@@ -306,6 +393,23 @@ def main(argv=None):
     from theanompi_tpu.launcher import _parse_kv
 
     cfg.update(_parse_kv(args.model_set))
+    if args.exchange_bench:
+        out = ("EXCHANGE.json" if args.out == "SCALING.json" else args.out)
+        art = exchange_microbench(
+            args.model, cfg, n=ns[-1],
+            strategies=tuple(args.strategies.split(",")),
+            steps=args.steps, trials=args.trials,
+            bucket_mb=args.bucket_mb, out_path=out)
+        for s, r in art["per_strategy"].items():
+            c = r["collectives"]
+            print(f"{s:18s} step {r['step_ms']:8.3f} ms  "
+                  f"wire {r['wire_bytes_per_step']:>12}  "
+                  f"ar {c.get('all-reduce', 0):3d}  "
+                  f"rs {c.get('reduce-scatter', 0):3d}  "
+                  f"ag {c.get('all-gather', 0):3d}  "
+                  f"perm {c.get('collective-permute', 0):3d}")
+        print(f"wrote {out}")
+        return
     art = measure_scaling(args.model, cfg, ns=ns, steps=args.steps,
                           trials=args.trials, strategy=args.strategy,
                           out_path=args.out)
