@@ -1,0 +1,260 @@
+"""ISSUE 3 tentpole: async checkpoint engine + epoch-boundary overlap.
+
+Covers the acceptance matrix on the 4-device CPU mesh: async-vs-sync
+bit-identical published ``.npz`` contents, ``try_resume`` round-trips
+(including ``zero1``, whose opt state is sharded flat buckets), a
+slow-writer injection proving ``save_checkpoint`` returns before the write
+completes, writer-exception surfacing at the next save/join, crash-mid-write
+recovery (tmp debris swept, resume from the previous epoch), prune ignoring
+crash debris, and ``checkpoint.snapshot`` / ``checkpoint.write`` span
+disjointness in the telemetry JSONL.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from theanompi_tpu.utils.checkpoint import Checkpointer
+
+TINY = {"depth": 10, "widen": 1, "batch_size": 8, "image_size": 8,
+        "n_train": 32, "n_val": 16, "n_epochs": 1, "precision": "fp32",
+        "augment": False, "verbose": False, "lr": 0.05}
+
+TREE = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+        "b": {"c": np.ones((4,), np.int32)}}
+
+
+def _tiny_trainer(mesh4, strategy="psum", checkpoint_dir=None,
+                  telemetry=None, checkpoint_async=True, n_epochs=1):
+    from theanompi_tpu.models.wide_resnet import WideResNet
+    from theanompi_tpu.parallel.bsp import BSPTrainer
+    from theanompi_tpu.utils.recorder import Recorder
+
+    t = BSPTrainer(
+        WideResNet({**TINY, "n_epochs": n_epochs}), mesh=mesh4,
+        exch_strategy=strategy,
+        recorder=Recorder(verbose=False, print_freq=4),
+        checkpoint_dir=checkpoint_dir, checkpoint_async=checkpoint_async,
+        telemetry=telemetry,
+    )
+    t.compile_iter_fns()
+    t.init_state()
+    return t
+
+
+def _npz_contents(path):
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}
+
+
+def test_async_and_sync_publish_bit_identical(tmp_path, mesh4):
+    """Same train state through both modes -> byte-equal array payloads
+    (one shared ``_write`` path is the design guarantee; this locks it)."""
+    trainer = _tiny_trainer(mesh4)
+    batch = next(iter(trainer.model.data.train_batches(
+        trainer.global_batch, 0, seed=0)))
+    trainer.train_iter(batch, lr=0.05)
+    trees = trainer.checkpoint_trees()
+
+    sync_ck = Checkpointer(str(tmp_path / "sync"), async_save=False)
+    sync_ck.save(0, 4, trees)
+    async_ck = Checkpointer(str(tmp_path / "async"), async_save=True)
+    handle = async_ck.save(0, 4, trees)
+    handle.join()
+
+    a = _npz_contents(sync_ck._path(0))
+    b = _npz_contents(async_ck._path(0))
+    assert sorted(a) == sorted(b)
+    for k in a:
+        assert a[k].dtype == b[k].dtype and a[k].shape == b[k].shape, k
+        assert a[k].tobytes() == b[k].tobytes(), k
+
+
+@pytest.mark.parametrize("strategy", ["psum", "zero1"])
+def test_async_resume_roundtrip(tmp_path, mesh4, strategy):
+    """Full run with async checkpointing resumes exactly — including
+    ``zero1``, whose opt state is flat buckets sharded over ``data``."""
+    ck = str(tmp_path / "ck")
+    trainer = _tiny_trainer(mesh4, strategy=strategy, checkpoint_dir=ck)
+    trainer.run()
+    params = jax.tree.map(np.asarray, trainer.params)
+    opt = jax.tree.map(np.asarray, trainer.opt_state)
+    iters = trainer.iteration
+
+    t2 = _tiny_trainer(mesh4, strategy=strategy, checkpoint_dir=ck)
+    assert t2.try_resume()
+    assert t2.epoch == 1 and t2.iteration == iters
+    for a, b in zip(jax.tree.leaves(t2.params), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(t2.opt_state), jax.tree.leaves(opt)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_save_checkpoint_returns_before_write_completes(tmp_path, mesh4):
+    """Slow-writer injection: the boundary pays only the snapshot; the
+    publish happens later on the writer thread."""
+    trainer = _tiny_trainer(mesh4, checkpoint_dir=str(tmp_path / "ck"))
+    trainer.checkpointer._pre_publish_hook = lambda epoch: time.sleep(0.8)
+    t0 = time.perf_counter()
+    handle = trainer.save_checkpoint(0)
+    returned_in = time.perf_counter() - t0
+    assert returned_in < 0.6, f"save_checkpoint blocked {returned_in:.2f}s"
+    assert not handle.done(), "writer should still be running"
+    assert not os.path.exists(handle.path), "published before the join!"
+    handle.join()
+    assert os.path.exists(handle.path)
+    # the recorder histories were written by the writer too (satellite:
+    # the boundary pays neither write)
+    assert os.path.exists(tmp_path / "ck" / "time_history.npy")
+
+
+def test_writer_exception_surfaces_at_next_save(tmp_path):
+    ck = Checkpointer(str(tmp_path), async_save=True)
+
+    def boom(epoch):
+        raise ValueError("disk full")
+
+    ck._pre_publish_hook = boom
+    ck.save(0, 1, {"params": TREE})
+    with pytest.raises(ValueError, match="disk full"):
+        ck.save(1, 2, {"params": TREE})  # join_pending re-raises here
+    # delivered exactly once; the engine keeps working afterwards
+    ck._pre_publish_hook = None
+    h = ck.save(2, 3, {"params": TREE})
+    h.join()
+    assert ck.latest_epoch() == 2
+
+
+def test_writer_exception_surfaces_at_join(tmp_path):
+    ck = Checkpointer(str(tmp_path), async_save=True)
+
+    def boom(epoch):
+        raise RuntimeError("torn write")
+
+    ck._pre_publish_hook = boom
+    handle = ck.save(0, 1, {"params": TREE})
+    with pytest.raises(RuntimeError, match="torn write"):
+        handle.join()
+
+
+def test_crash_mid_write_resumes_previous_epoch(tmp_path, mesh4):
+    """Kill the writer before ``os.replace``: the tmp debris must not count
+    as a checkpoint, and a restarted process resumes from the previous
+    epoch's published state."""
+    ck_dir = str(tmp_path / "ck")
+    trainer = _tiny_trainer(mesh4, checkpoint_dir=ck_dir, n_epochs=2)
+    trainer.run()  # publishes epochs 0 and 1
+    params_e1 = jax.tree.map(np.asarray, trainer.params)
+
+    # epoch 2's save dies after serialization, before the atomic publish
+    def crash(epoch):
+        raise RuntimeError("simulated kill before publish")
+
+    trainer.checkpointer._pre_publish_hook = crash
+    trainer.iteration += 1
+    handle = trainer.save_checkpoint(2)
+    with pytest.raises(RuntimeError, match="simulated kill"):
+        handle.join()
+    debris = [f for f in os.listdir(ck_dir) if f.endswith(".tmp.npz")]
+    assert debris == ["ckpt_e0002.npz.tmp.npz"]
+
+    # "restart": a fresh trainer sweeps the debris and resumes from the
+    # last PUBLISHED epoch (1), with its exact params
+    t2 = _tiny_trainer(mesh4, checkpoint_dir=ck_dir, n_epochs=2)
+    assert not any(f.endswith(".tmp.npz") for f in os.listdir(ck_dir))
+    assert t2.try_resume()
+    assert t2.epoch == 2  # epoch 1 completed; 2 is the resume point
+    for a, b in zip(jax.tree.leaves(t2.params), jax.tree.leaves(params_e1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_prune_ignores_tmp_debris(tmp_path):
+    """A leftover ``ckpt_eNNNN.npz.tmp.npz`` startswith ``ckpt_e`` and
+    endswith ``.npz`` — it must not consume a retention slot or shift which
+    real checkpoints get deleted."""
+    ck = Checkpointer(str(tmp_path), keep=2)
+    for e in range(3):
+        ck.save(e, e, {"params": TREE})
+    debris = tmp_path / "ckpt_e0003.npz.tmp.npz"
+    debris.touch()
+    ck.save(4, 4, {"params": TREE})
+    real = sorted(f for f in os.listdir(tmp_path)
+                  if f.startswith("ckpt_e") and not f.endswith(".tmp.npz"))
+    # keep=2 of the REAL checkpoints: 2 and 4 survive (debris uncounted)
+    assert real == ["ckpt_e0002.npz", "ckpt_e0004.npz"]
+    assert debris.exists()  # prune never deletes debris; init sweeps it
+    ck2 = Checkpointer(str(tmp_path), keep=2)
+    assert not debris.exists()
+    assert ck2.latest_epoch() == 4
+
+
+def test_snapshot_and_write_spans_disjoint(tmp_path, mesh4):
+    """Acceptance: the training-thread ``checkpoint.snapshot`` span ends
+    before the writer's ``checkpoint.write`` span begins, on distinct
+    threads, with byte accounting on the write."""
+    from theanompi_tpu.telemetry import Telemetry
+    from theanompi_tpu.telemetry.sink import read_events, sink_files
+
+    tel_dir = str(tmp_path / "tel")
+    tel = Telemetry(tel_dir)
+    trainer = _tiny_trainer(mesh4, checkpoint_dir=str(tmp_path / "ck"),
+                            telemetry=tel)
+    trainer.run()
+    tel.close()
+
+    events = []
+    for p in sink_files(tel_dir):
+        events.extend(read_events(p))
+    snaps = [e for e in events
+             if e["kind"] == "span" and e["name"] == "checkpoint.snapshot"]
+    writes = [e for e in events
+              if e["kind"] == "span" and e["name"] == "checkpoint.write"]
+    assert len(snaps) == 1 and len(writes) == 1
+    snap, write = snaps[0], writes[0]
+    assert snap["ts"] + snap["dur"] <= write["ts"], (
+        "snapshot and write overlap")
+    assert snap["tid"] != write["tid"], "write ran on the training thread"
+    assert write["bytes"] > 0 and write["dur"] > 0
+    # the old monolithic span is gone
+    assert not any(e.get("name") == "checkpoint.save" for e in events)
+
+
+def test_next_epoch_prefetcher_built_before_boundary(tmp_path, mesh4,
+                                                     monkeypatch):
+    """Satellite: the next epoch's prefetcher exists (queue refilling)
+    before validate/checkpoint run at the boundary."""
+    import theanompi_tpu.parallel.trainer as trainer_mod
+
+    trainer = _tiny_trainer(mesh4, checkpoint_dir=str(tmp_path / "ck"),
+                            n_epochs=2)
+    order = []
+    built = []
+
+    orig_make = trainer_mod.BaseTrainer._make_prefetcher
+    orig_validate = trainer_mod.BaseTrainer.validate
+    orig_save = trainer_mod.BaseTrainer.save_checkpoint
+
+    monkeypatch.setattr(
+        trainer_mod.BaseTrainer, "_make_prefetcher",
+        lambda self, epoch: (order.append(("prefetch", epoch)),
+                             built.append(epoch),
+                             orig_make(self, epoch))[-1])
+    monkeypatch.setattr(
+        trainer_mod.BaseTrainer, "validate",
+        lambda self, epoch: (order.append(("validate", epoch)),
+                             orig_validate(self, epoch))[-1])
+    monkeypatch.setattr(
+        trainer_mod.BaseTrainer, "save_checkpoint",
+        lambda self, epoch: (order.append(("checkpoint", epoch)),
+                             orig_save(self, epoch))[-1])
+
+    trainer.run()
+    assert built == [0, 1]  # one per epoch, none for past-the-end
+    # at the epoch-0 boundary: epoch 1's prefetcher precedes validate(0)
+    # and checkpoint(0)
+    assert order.index(("prefetch", 1)) < order.index(("validate", 0))
+    assert order.index(("validate", 0)) < order.index(("checkpoint", 0))

@@ -42,12 +42,16 @@ def test_checkpointer_shape_mismatch(tmp_path):
 
 
 @pytest.mark.slow
-def test_bsp_resume_continues_state(tmp_path, mesh8):
-    """Train 2 epochs with checkpointing; resume restores params exactly."""
+@pytest.mark.parametrize("checkpoint_async", [True, False],
+                         ids=["async", "sync"])
+def test_bsp_resume_continues_state(tmp_path, mesh8, checkpoint_async):
+    """Train 2 epochs with checkpointing; resume restores params exactly
+    (parametrized over the async/sync writer — ISSUE 3)."""
     from theanompi_tpu import BSP
 
     cfg = {"verbose": False, "print_freq": 4,
-           "checkpoint_dir": str(tmp_path / "ck")}
+           "checkpoint_dir": str(tmp_path / "ck"),
+           "checkpoint_async": checkpoint_async}
     rule = BSP(config=cfg)
     rule.init(devices=8, modelfile="theanompi_tpu.models.wide_resnet",
               modelclass="WideResNet", model_config=dict(TINY))
@@ -96,6 +100,56 @@ def test_launcher_kv_parsing():
                  "name": "foo"}
     with pytest.raises(SystemExit):
         _parse_kv(["novalue"])
+
+
+def _launch_subprocess(tmp_path, cache_dir, tag):
+    """One tmlauncher subprocess on a 4-virtual-device CPU mesh with a
+    shared compile cache + telemetry; -> its compile.first_step_s gauge."""
+    import subprocess
+    import sys
+
+    from theanompi_tpu.telemetry.sink import read_events, sink_files
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    tel = str(tmp_path / f"tel_{tag}")
+    subprocess.run(
+        [sys.executable, "-m", "theanompi_tpu.launcher",
+         "--rule", "BSP", "--devices", "4",
+         "--modelfile", "theanompi_tpu.models.wide_resnet",
+         "--modelclass", "WideResNet",
+         "--set", "depth=10", "--set", "widen=1", "--set", "batch_size=4",
+         "--set", "image_size=8", "--set", "n_train=16", "--set", "n_val=8",
+         "--set", "n_epochs=1", "--set", "precision='fp32'",
+         "--compile-cache-dir", str(cache_dir),
+         "--telemetry-dir", tel, "--quiet"],
+        env=env, check=True, timeout=480,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    gauges = [e["value"] for p in sink_files(tel) for e in read_events(p)
+              if e.get("kind") == "gauge"
+              and e.get("name") == "compile.first_step_s"]
+    assert len(gauges) == 1, f"expected one first-compile gauge, got {gauges}"
+    return gauges[0]
+
+
+def test_compile_cache_smoke(tmp_path):
+    """ISSUE 3 CI satellite: two launcher subprocesses sharing a compile
+    cache — the first populates it, the second's recorded first-compile
+    time drops (it loads the compiled executables instead of recompiling).
+    Subprocesses, not in-process runs: the persistent-cache win is
+    precisely the cross-process one, and jax wires the cache config at
+    backend init."""
+    cache = tmp_path / "ccache"
+    cold = _launch_subprocess(tmp_path, cache, "cold")
+    entries = [f for f in os.listdir(cache) if f.endswith("-cache")]
+    assert entries, "first run did not populate the compile cache"
+    warm = _launch_subprocess(tmp_path, cache, "warm")
+    assert warm < cold, (
+        f"cache hit did not drop first-compile time: cold {cold:.2f}s "
+        f"-> warm {warm:.2f}s"
+    )
 
 
 @pytest.mark.slow

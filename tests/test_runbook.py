@@ -42,22 +42,35 @@ def test_runbook_scaling_command(tmp_path):
 
 
 def test_runbook_launcher_command(tmp_path):
-    """RUNBOOK step 4's tmlauncher invocation, shrunk to one tiny epoch."""
+    """RUNBOOK step 4's tmlauncher invocation, shrunk to one tiny epoch
+    (now with the ISSUE 3 knobs: --compile-cache-dir, --checkpoint-dir and
+    the checkpoint_async rule key the RUNBOOK documents)."""
+    import jax
+
     record = str(tmp_path / "record")
     telemetry = str(tmp_path / "telemetry")
-    rc = launcher.main([
-        "--rule", "BSP", "--devices", "8",
-        "--modelfile", "theanompi_tpu.models.resnet50",
-        "--modelclass", "ResNet50",
-        "--set", "batch_size=2", "--set", "n_epochs=1",
-        "--set", "image_size=32", "--set", "store_size=40",
-        "--set", "stage_blocks=(1,1,1,1)",
-        "--set", "n_classes=4", "--set", "n_train=32", "--set", "n_val=16",
-        "--set", "shard_size=16", "--set", "precision=fp32",
-        "--rule-set", "exch_strategy=psum_bf16_bucket",
-        "--rule-set", "exch_bucket_mb=4",
-        "--record-dir", record, "--telemetry-dir", telemetry, "--quiet",
-    ])
+    cache = str(tmp_path / "ccache")
+    ckpt = str(tmp_path / "ckpt")
+    try:
+        rc = launcher.main([
+            "--rule", "BSP", "--devices", "8",
+            "--modelfile", "theanompi_tpu.models.resnet50",
+            "--modelclass", "ResNet50",
+            "--set", "batch_size=2", "--set", "n_epochs=1",
+            "--set", "image_size=32", "--set", "store_size=40",
+            "--set", "stage_blocks=(1,1,1,1)",
+            "--set", "n_classes=4", "--set", "n_train=32", "--set", "n_val=16",
+            "--set", "shard_size=16", "--set", "precision=fp32",
+            "--rule-set", "exch_strategy=psum_bf16_bucket",
+            "--rule-set", "exch_bucket_mb=4",
+            "--rule-set", "checkpoint_async=True",
+            "--checkpoint-dir", ckpt, "--compile-cache-dir", cache,
+            "--record-dir", record, "--telemetry-dir", telemetry, "--quiet",
+        ])
+    finally:
+        # the cache dir is a tmp_path about to vanish: un-wire it so later
+        # tests' compiles don't try to persist into a deleted directory
+        jax.config.update("jax_compilation_cache_dir", None)
     assert rc == 0
     # the recorder histories the RUNBOOK points at
     assert any(f.endswith(".npy") for f in os.listdir(record))
@@ -67,6 +80,12 @@ def test_runbook_launcher_command(tmp_path):
     assert "trace.json" in files and "summary.json" in files
     trace = json.load(open(os.path.join(telemetry, "trace.json")))
     assert trace["traceEvents"]
+    # the ISSUE 3 knobs did their jobs: compile cache populated, an async
+    # checkpoint published with its latest pointer
+    assert any(f.endswith("-cache") for f in os.listdir(cache))
+    assert "latest.json" in os.listdir(ckpt)
+    assert any(f.startswith("ckpt_e") and f.endswith(".npz")
+               for f in os.listdir(ckpt))
 
 
 def test_runbook_exchange_bench_command(tmp_path):

@@ -85,6 +85,12 @@ def build_parser() -> argparse.ArgumentParser:
                    "(Perfetto-loadable) + summary.json (cross-rank skew) "
                    "at the end of the run")
     p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--compile-cache-dir", default=None,
+                   help="persistent XLA compilation-cache directory, shared "
+                   "across runs: a restart/resume/sweep subprocess with the "
+                   "same programs loads compiled executables instead of "
+                   "repaying the full compile (also: THEANOMPI_COMPILE_CACHE "
+                   "env var)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quiet", action="store_true")
@@ -94,6 +100,11 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     _maybe_init_distributed()
+    if args.compile_cache_dir:
+        # before the first jit dispatch (rule.init compiles lazily later)
+        from theanompi_tpu.parallel.mesh import setup_compile_cache
+
+        setup_compile_cache(args.compile_cache_dir)
 
     model_config: dict = {}
     rule_config: dict = {}

@@ -69,6 +69,56 @@ def force_host_devices(n: int) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+def setup_compile_cache(directory: str | None = None,
+                        min_compile_secs: float | None = None) -> str | None:
+    """Wire JAX's persistent compilation cache (ISSUE 3 platform setup).
+
+    Without it every restart, resume, and scaling-sweep subprocess repays
+    the full XLA compile (the PR 1 runbook dry-run measured 370 s,
+    dominated by compile).  With a shared ``directory``, the first process
+    populates it and every later process with identical programs loads the
+    compiled executable instead — the trainer's ``compile.first_step_s``
+    gauge makes the hit visible.
+
+    ``directory=None`` falls back to the ``THEANOMPI_COMPILE_CACHE`` env
+    var; with neither set this is a no-op returning None.  Call before the
+    first jit dispatch (config flips are ignored for already-compiled
+    programs, not an error).  ``min_compile_secs=None`` (the production
+    default — launcher/scaling/bench) keeps jax's own floor (1 s), so a
+    pod of hosts does not spray every sub-second helper jit into shared
+    storage; the expensive train/eval programs the cache exists for are
+    multi-second compiles and persist regardless.  Tests that must observe
+    hits on tiny sub-second programs pass an explicit ``0``.
+    """
+    directory = directory or os.environ.get("THEANOMPI_COMPILE_CACHE")
+    if not directory:
+        return None
+    directory = os.path.abspath(os.path.expanduser(directory))
+    os.makedirs(directory, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", directory)
+    if min_compile_secs is not None:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_secs))
+        try:
+            # -1 disables the entry-size floor (name/semantics exist from
+            # jax 0.4.30 on; older jax simply keeps its default)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+        except AttributeError:
+            pass
+    try:
+        # jax latches "no cache" at the first compile that ran before this
+        # config flip (compilation_cache._cache_checked); a reset makes the
+        # next compile re-read the config — required whenever anything
+        # already jitted in this process (e.g. the test suite's dry-runs)
+        from jax._src import compilation_cache
+
+        compilation_cache.reset_cache()
+    except Exception:
+        pass  # private surface: a moved symbol must not break the launcher
+    return directory
+
+
 def make_mesh(
     n_data: int | None = None,
     n_model: int = 1,

@@ -280,6 +280,7 @@ class BaseTrainer:
     def __init__(self, model, mesh=None, recorder: Recorder | None = None,
                  seed: int = 0, prefetch_depth: int = 2,
                  checkpoint_dir: str | None = None, checkpoint_keep: int = 3,
+                 checkpoint_async: bool = True,
                  profile_dir: str | None = None,
                  profile_window: tuple[int, int] = (10, 20),
                  telemetry=None):
@@ -294,7 +295,11 @@ class BaseTrainer:
         if checkpoint_dir:
             from theanompi_tpu.utils.checkpoint import Checkpointer
 
-            self.checkpointer = Checkpointer(checkpoint_dir, keep=checkpoint_keep)
+            # async by default (ISSUE 3): the boundary only pays the
+            # snapshot; serialization/publish/prune run on the writer
+            self.checkpointer = Checkpointer(
+                checkpoint_dir, keep=checkpoint_keep,
+                async_save=checkpoint_async, telemetry=telemetry)
         self.optimizer = model.build_optimizer()
         self.global_batch = model.batch_size * self.n_workers
         self._step_fn = None
@@ -326,6 +331,7 @@ class BaseTrainer:
         self._flops_per_step: float | None = None  # None = not yet probed
         self._peak_flops: float | None = None
         self._last_metrics_flush: float | None = None
+        self._first_step_emitted = False  # compile.first_step_s gauge latch
 
     # -- subclass surface ----------------------------------------------------
     def compile_iter_fns(self) -> None:
@@ -443,13 +449,21 @@ class BaseTrainer:
             "opt_state": self.opt_state,
         }
 
-    def save_checkpoint(self, epoch: int) -> None:
-        if self.checkpointer is not None:
-            with (self.telemetry.span("checkpoint.save", epoch=epoch)
-                  if self.telemetry is not None else nullcontext()):
-                self.checkpointer.save(
-                    epoch, self.iteration, self.checkpoint_trees())
-                self.recorder.save(self.checkpointer.directory)
+    def save_checkpoint(self, epoch: int):
+        """Kick off a checkpoint save; -> SaveHandle (or None, no dir).
+
+        The training thread pays only the blocking snapshot (multi-host
+        gathers + overlapped device→host copies + a cheap recorder-history
+        list copy), emitted as the ``checkpoint.snapshot`` span inside the
+        checkpointer; serialization, atomic publish, the recorder-history
+        write and pruning run on the background writer (``checkpoint.write``
+        span) unless ``checkpoint_async=False``.
+        """
+        if self.checkpointer is None:
+            return None
+        return self.checkpointer.save(
+            epoch, self.iteration, self.checkpoint_trees(),
+            recorder_snapshot=self.recorder.history_snapshot())
 
     def try_resume(self) -> bool:
         """Restore the latest checkpoint if one exists; -> resumed or not.
@@ -655,6 +669,13 @@ class BaseTrainer:
             tel.emit_span("train.step", step_t0, dur,
                           step=step_idx, epoch=epoch_idx)
             tel.observe("train.step_s", dur)
+            if not self._first_step_emitted:
+                # first-compile visibility (ISSUE 3): the first dispatch
+                # pays tracing + XLA compile synchronously — or a
+                # persistent-cache hit.  This gauge is the witness that
+                # --compile-cache-dir works: a warm cache makes it drop.
+                self._first_step_emitted = True
+                tel.gauge("compile.first_step_s", dur, step=step_idx)
             wire = self._exchange_accounting()
             if wire:
                 tel.count("exchange.wire_bytes", wire, emit=True,
@@ -721,6 +742,19 @@ class BaseTrainer:
         return means
 
     # -- full run (reference *_worker.run) -----------------------------------
+    def _make_prefetcher(self, epoch: int):
+        """The para_load equivalent: read/augment/transfer overlaps compute."""
+        from theanompi_tpu.models.data.prefetch import prefetch
+
+        return prefetch(
+            self.model.data.train_batches(self.global_batch, epoch,
+                                          seed=self.seed),
+            mesh=self.mesh,
+            depth=self.prefetch_depth,
+            spec=self.batch_spec,
+            telemetry=self.telemetry,
+        )
+
     def run(self, stop=None):
         """Train to completion.
 
@@ -732,23 +766,15 @@ class BaseTrainer:
             self.compile_iter_fns()
         if self.params is None:
             self.init_state()
-        from theanompi_tpu.models.data.prefetch import prefetch
-
         model = self.model
+        batches = None
         try:
             for epoch in range(self.epoch, model.n_epochs):
                 self.epoch = epoch
                 self.recorder.start_epoch()
                 lr = model.adjust_hyperp(epoch)
-                # para_load equivalent: read/augment/transfer overlaps compute
-                batches = prefetch(
-                    model.data.train_batches(self.global_batch, epoch,
-                                             seed=self.seed),
-                    mesh=self.mesh,
-                    depth=self.prefetch_depth,
-                    spec=self.batch_spec,
-                    telemetry=self.telemetry,
-                )
+                if batches is None:  # not pre-built at the last boundary
+                    batches = self._make_prefetcher(epoch)
                 it = iter(batches)
                 try:
                     while True:
@@ -772,6 +798,15 @@ class BaseTrainer:
                     close = getattr(batches, "close", None)
                     if close is not None:
                         close()
+                    batches = None
+                # epoch-boundary overlap (ISSUE 3): build the NEXT epoch's
+                # prefetcher BEFORE validate + checkpoint, so its loader
+                # thread refills the input queue while the host validates
+                # and the checkpoint writer runs — the first post-boundary
+                # step no longer starts on a cold queue (its 'wait' segment
+                # is the witness)
+                if epoch + 1 < model.n_epochs:
+                    batches = self._make_prefetcher(epoch + 1)
                 val = self.validate(epoch)
                 self.save_checkpoint(epoch)
                 if self.telemetry is not None:
@@ -782,11 +817,36 @@ class BaseTrainer:
                 if stop is not None and stop(epoch, val):
                     break
         finally:
+            # an early stop() or an exception leaves the pre-built next-epoch
+            # prefetcher alive — close it so its thread stops pinning batches
+            if batches is not None:
+                close = getattr(batches, "close", None)
+                if close is not None:
+                    close()
             # window ran past the end of training, OR an exception landed
             # inside it — either way the device trace must be stopped and
             # flushed, not leaked (the bounded-window contract)
             if self._profiling:
                 self._profile_stop()
+            # at most one in-flight checkpoint writer: exit joins it (like
+            # the next save or a resume would), so a writer exception
+            # surfaces here instead of dying with the daemon thread.  But
+            # when a PRIMARY exception is already unwinding (often the same
+            # root cause — full disk, dead mount), the writer's error must
+            # not supersede it: report and let the original propagate (the
+            # same correlated-failure discipline Rule.wait applies to
+            # telemetry finalize)
+            if self.checkpointer is not None:
+                import sys
+
+                if sys.exc_info()[0] is None:
+                    self.checkpointer.join_pending()
+                else:
+                    try:
+                        self.checkpointer.join_pending()
+                    except Exception as e:
+                        print(f"checkpoint writer failed during teardown: "
+                              f"{e}", file=sys.stderr)
         self.recorder.save()
         model.cleanup()
         return self.recorder
@@ -823,6 +883,7 @@ class Rule:
             prefetch_depth=self.config.get("prefetch", 2),
             checkpoint_dir=self.config.get("checkpoint_dir"),
             checkpoint_keep=self.config.get("checkpoint_keep", 3),
+            checkpoint_async=self.config.get("checkpoint_async", True),
             profile_dir=self.config.get("profile_dir"),
             profile_window=tuple(self.config.get("profile_window", (10, 20))),
             telemetry=self.make_telemetry(),

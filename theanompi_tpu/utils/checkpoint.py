@@ -1,8 +1,10 @@
-"""Checkpoint/resume: per-epoch pytree snapshots + recorder histories.
+"""Checkpoint/resume: async per-epoch pytree snapshots + recorder histories.
 
 Reference (unverified — SURVEY.md §5): rank-0 (or the EASGD server) saved
 ``params`` as ``.npy`` per epoch via ``Weight.save()``/helper save; resume
-loaded a configured epoch's weights and the Recorder histories.
+loaded a configured epoch's weights and the Recorder histories.  That save
+was fully synchronous — the whole epoch boundary stopped while rank 0
+serialized.
 
 Here the whole train state (params/state/opt_state plus rule extras like the
 EASGD center or GOSGD weights) is flattened by key path into one ``.npz``
@@ -11,12 +13,38 @@ template (the freshly initialized state) so pytree structure and shardings
 come from the trainer, not the file — arrays are placed back with each
 template leaf's sharding, making checkpoints portable across mesh shapes as
 long as the logical state matches.
+
+**Async engine (ISSUE 3)** — the save is split into two phases so the host
+write leaves the training thread's critical path (the t5x/orbax-style
+async-snapshot shape):
+
+- ``snapshot`` (training thread, ``checkpoint.snapshot`` span): multi-host
+  gather collectives for cross-host-sharded leaves — those MUST stay on the
+  main thread, every process reaches them — plus overlapped non-blocking
+  device→host copies (``copy_to_host_async`` is issued on *every*
+  addressable leaf before the first materializing read, so the waits
+  overlap and the cost is ~the slowest transfer, not the sum).  The
+  snapshot materializes to numpy *here*, not on the writer: the train step
+  donates the param/state/opt buffers, so a device array referenced past
+  the boundary may be invalidated by the very next dispatched step — plain
+  numpy is immune.
+- ``write`` (background writer thread, ``checkpoint.write`` span with byte
+  and duration gauges): ``np.savez`` serialization, atomic publish
+  (``os.replace`` + ``latest.json`` — the crash-safety contract is
+  unchanged), recorder-history write, retention prune.
+
+At most one save is in flight: the next save / a load / exit joins the
+previous via :meth:`Checkpointer.join_pending`, and a writer exception is
+re-raised at that join — never swallowed.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
+import time
+from contextlib import nullcontext
 
 import jax
 import numpy as np
@@ -37,13 +65,6 @@ def _to_host(leaf) -> np.ndarray:
 
 def _leaf_key(path) -> str:
     return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
-
-
-def _flatten(tree) -> dict[str, np.ndarray]:
-    out = {}
-    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        out[_leaf_key(path)] = _to_host(leaf)
-    return out
 
 
 def _restore_into(template, arrays: dict[str, np.ndarray]):
@@ -69,43 +90,178 @@ def _restore_into(template, arrays: dict[str, np.ndarray]):
     )
 
 
-class Checkpointer:
-    """Directory of ``ckpt_eNNNN.npz`` files + ``latest.json`` pointer."""
+class SaveHandle:
+    """One (possibly in-flight) checkpoint save.
 
-    def __init__(self, directory: str, keep: int = 3):
+    ``join()`` blocks until the write is published and re-raises any writer
+    exception exactly once.  A handle for a synchronous save (or for a
+    non-writing rank on a pod) is already complete.
+    """
+
+    __slots__ = ("path", "epoch", "_thread", "_error")
+
+    def __init__(self, path: str, epoch: int):
+        self.path = path
+        self.epoch = epoch
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
+
+    def done(self) -> bool:
+        return self._thread is None or not self._thread.is_alive()
+
+    def join(self) -> None:
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+        err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+
+class Checkpointer:
+    """Directory of ``ckpt_eNNNN.npz`` files + ``latest.json`` pointer.
+
+    ``async_save=True`` runs serialization/publish/prune on a background
+    writer thread (see module docstring); the default for a bare
+    ``Checkpointer`` stays synchronous so direct library use keeps the old
+    semantics — the trainer opts into async via its ``checkpoint_async``
+    config (default on).
+    """
+
+    def __init__(self, directory: str, keep: int = 3,
+                 async_save: bool = False, telemetry=None):
         self.directory = directory
         self.keep = keep
+        self.async_save = async_save
+        self.telemetry = telemetry
+        self._inflight: SaveHandle | None = None
+        #: test seam: called on the writer between serialization and the
+        #: atomic publish — a sleep makes the writer observably slow, a
+        #: raise simulates a crash mid-write (tmp written, never published)
+        self._pre_publish_hook = None
         os.makedirs(directory, exist_ok=True)
+        self._sweep_tmp()
+
+    def _sweep_tmp(self) -> None:
+        """Remove crash debris (``*.tmp.npz`` / ``latest.json.tmp``) left by
+        a writer killed before its atomic publish — without the sweep a
+        leftover ``ckpt_e0003.npz.tmp.npz`` both startswith ``ckpt_e`` and
+        endswith ``.npz`` and would corrupt retention ordering."""
+        for f in os.listdir(self.directory):
+            if f.endswith(".tmp.npz") or f == "latest.json.tmp":
+                try:
+                    os.remove(os.path.join(self.directory, f))
+                except OSError:
+                    pass  # concurrent cleanup / permissions: not fatal
 
     def _path(self, epoch: int) -> str:
         return os.path.join(self.directory, f"ckpt_e{epoch:04d}.npz")
 
-    def save(self, epoch: int, iteration: int, trees: dict) -> str:
+    def join_pending(self) -> None:
+        """Wait for the in-flight writer (if any); re-raise its exception.
+
+        The in-flight slot is cleared before the potential raise, so a
+        writer error is delivered exactly once — at the first join after it
+        happened (the next save, a load, or trainer exit)."""
+        h, self._inflight = self._inflight, None
+        if h is not None:
+            h.join()
+
+    def _snapshot(self, trees: dict) -> dict[str, np.ndarray]:
+        """The blocking, training-thread portion of a save.
+
+        Cross-host-sharded leaves gather via collectives (every process
+        must reach them).  Addressable device leaves get their device→host
+        copies STARTED non-blocking first, on every leaf, then materialized
+        — the waits overlap, so this costs ~the slowest single transfer.
+        Materialization cannot move to the writer thread: the train step
+        donates the param/state/opt buffers, so the device arrays
+        referenced here may be invalidated the moment the next step is
+        dispatched; the writer only ever sees numpy.
+        """
+        staged: dict[str, object] = {}
+        for name, tree in trees.items():
+            for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+                key = f"{name}::{_leaf_key(path)}"
+                if isinstance(leaf, jax.Array) and leaf.is_fully_addressable:
+                    leaf.copy_to_host_async()
+                    staged[key] = leaf
+                else:
+                    staged[key] = _to_host(leaf)  # collective on a pod
+        return {k: np.asarray(v) for k, v in staged.items()}
+
+    def save(self, epoch: int, iteration: int, trees: dict,
+             recorder_snapshot: dict | None = None) -> SaveHandle:
         """``trees``: name -> pytree (params/state/opt_state/extras).
 
         On a multi-host pod every process must call this (the host-gather of
         cross-host-sharded leaves is a collective); only process 0 writes.
+        Returns a :class:`SaveHandle`; with ``async_save`` the handle may
+        still be writing — at most one save is in flight (this call joins
+        the previous one first, re-raising its error if it failed).
         """
-        flat: dict[str, np.ndarray] = {}
-        for name, tree in trees.items():
-            for k, v in _flatten(tree).items():
-                flat[f"{name}::{k}"] = v
-        path = self._path(epoch)
+        self.join_pending()
+        tel = self.telemetry
+        with (tel.span("checkpoint.snapshot", epoch=epoch)
+              if tel is not None else nullcontext()):
+            flat = self._snapshot(trees)
+        handle = SaveHandle(self._path(epoch), epoch)
         if jax.process_index() != 0:
-            return path
-        np.savez(path + ".tmp.npz", **flat)
-        os.replace(path + ".tmp.npz", path)  # atomic publish
+            return handle
+        if not self.async_save:
+            self._write(handle, epoch, iteration, flat, recorder_snapshot)
+            return handle
+
+        def work():
+            try:
+                self._write(handle, epoch, iteration, flat,
+                            recorder_snapshot)
+            except BaseException as e:
+                handle._error = e
+
+        handle._thread = threading.Thread(
+            target=work, name=f"ckpt-writer-e{epoch:04d}", daemon=True)
+        self._inflight = handle
+        handle._thread.start()
+        return handle
+
+    def _write(self, handle: SaveHandle, epoch: int, iteration: int,
+               flat: dict[str, np.ndarray],
+               recorder_snapshot: dict | None) -> None:
+        """Serialize + atomically publish + prune (writer thread in async
+        mode, inline in sync mode — one code path, so the published bytes
+        are identical either way)."""
+        t0 = time.perf_counter()
+        tmp = handle.path + ".tmp.npz"
+        np.savez(tmp, **flat)
+        if self._pre_publish_hook is not None:
+            self._pre_publish_hook(epoch)
+        os.replace(tmp, handle.path)  # atomic publish
         latest = os.path.join(self.directory, "latest.json")
         with open(latest + ".tmp", "w") as f:
             json.dump({"epoch": epoch, "iteration": iteration}, f)
         os.replace(latest + ".tmp", latest)  # a crash must not truncate it
+        if recorder_snapshot is not None:
+            from theanompi_tpu.utils.recorder import write_history_snapshot
+
+            write_history_snapshot(recorder_snapshot, self.directory)
         self._prune()
-        return path
+        if self.telemetry is not None:
+            dur = time.perf_counter() - t0
+            nbytes = sum(int(a.nbytes) for a in flat.values())
+            self.telemetry.emit_span("checkpoint.write", t0, dur,
+                                     epoch=epoch, bytes=nbytes)
+            self.telemetry.gauge("checkpoint.write_bytes", float(nbytes),
+                                 epoch=epoch)
+            self.telemetry.gauge("checkpoint.write_s", dur, epoch=epoch)
 
     def _prune(self) -> None:
         ckpts = sorted(
             f for f in os.listdir(self.directory)
             if f.startswith("ckpt_e") and f.endswith(".npz")
+            # crash debris is not a checkpoint: ckpt_e0003.npz.tmp.npz
+            # passes both tests above and would poison retention ordering
+            and not f.endswith(".tmp.npz")
         )
         for f in ckpts[: max(0, len(ckpts) - self.keep)]:
             os.remove(os.path.join(self.directory, f))
@@ -129,6 +285,7 @@ class Checkpointer:
         would leave process 0 resuming while the others start fresh —
         desynchronizing the SPMD program at the first collective.
         """
+        self.join_pending()  # read-your-writes: publish before deciding
         ep, it = self._local_latest()
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
@@ -152,6 +309,7 @@ class Checkpointer:
         so the checkpoint dir does NOT need to be a shared filesystem (it
         only ever needs process 0's disk).
         """
+        self.join_pending()  # an in-flight write must publish first
         if jax.process_count() > 1:
             return self._load_multihost(epoch, templates)
         with np.load(self._path(epoch)) as z:

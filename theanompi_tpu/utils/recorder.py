@@ -136,31 +136,25 @@ class Recorder:
         return vals[-1] if vals else None
 
     # -- persistence (reference dumped .npy histories into record/) ---------
+    def history_snapshot(self) -> dict:
+        """Point-in-time copy of the three histories as plain lists.
+
+        Cheap (list copies on the calling thread), so the async checkpoint
+        writer can serialize it off-thread without racing later iterations
+        mutating the live defaultdicts (ISSUE 3 — the boundary pays neither
+        the .npy nor the .npz write).
+        """
+        return {
+            "time": {k: list(v) for k, v in self.time_history.items()},
+            "train": {k: list(v) for k, v in self.train_history.items()},
+            "val": {k: list(v) for k, v in self.val_history.items()},
+        }
+
     def save(self, path: str | None = None) -> None:
         path = path or self.save_dir
         if path is None:
             return
-        os.makedirs(path, exist_ok=True)
-        for name, hist in (
-            ("time", self.time_history),
-            ("train", self.train_history),
-            ("val", self.val_history),
-        ):
-            np.save(
-                os.path.join(path, f"{name}_history.npy"),
-                {k: np.asarray(v) for k, v in hist.items()},
-                allow_pickle=True,
-            )
-        with open(os.path.join(path, "summary.json"), "w") as f:
-            json.dump(
-                {
-                    "iters": len(self.time_history["calc"]),
-                    "last_val": {
-                        k: v[-1] for k, v in self.val_history.items() if v
-                    },
-                },
-                f,
-            )
+        write_history_snapshot(self.history_snapshot(), path)
 
     def load(self, path: str | None = None) -> None:
         path = path or self.save_dir
@@ -176,3 +170,29 @@ class Recorder:
                 loaded = np.load(p, allow_pickle=True).item()
                 hist.clear()
                 hist.update({k: list(v) for k, v in loaded.items()})
+
+
+def write_history_snapshot(snapshot: dict, path: str) -> None:
+    """Serialize a :meth:`Recorder.history_snapshot` to ``path`` — the
+    ``*_history.npy`` files + ``summary.json`` :meth:`Recorder.load` reads.
+    Split out of :meth:`Recorder.save` so the async checkpoint writer can
+    run it on the background thread against an immutable snapshot."""
+    os.makedirs(path, exist_ok=True)
+    for name in ("time", "train", "val"):
+        hist = snapshot.get(name, {})
+        np.save(
+            os.path.join(path, f"{name}_history.npy"),
+            {k: np.asarray(v) for k, v in hist.items()},
+            allow_pickle=True,
+        )
+    with open(os.path.join(path, "summary.json"), "w") as f:
+        json.dump(
+            {
+                "iters": len(snapshot.get("time", {}).get("calc", ())),
+                "last_val": {
+                    k: v[-1]
+                    for k, v in snapshot.get("val", {}).items() if v
+                },
+            },
+            f,
+        )
