@@ -19,6 +19,15 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
+def subproc_compile_cache(tmp_path_factory):
+    """Shared persistent compile cache for subprocess-spawning tests
+    (resilience e2e, runbook supervision): the first child pays the XLA
+    compile, every later child with the same program loads it.  Resumed
+    children skip it by design (the launcher's jaxlib cache-load guard)."""
+    return str(tmp_path_factory.mktemp("subproc-ccache"))
+
+
+@pytest.fixture(scope="session")
 def mesh8():
     from theanompi_tpu.parallel.mesh import make_mesh
 

@@ -1,0 +1,245 @@
+"""Resilience e2e (ISSUE 4 acceptance): supervised SIGKILL -> restart ->
+auto-resume with crash-resume EQUIVALENCE, NaN-sentinel policies on the
+real trainer, and SIGTERM preemption's resumable exit.
+
+Subprocess children run with the SAME virtual-device topology and RNG
+flavor as the in-process session (8 forced CPU devices +
+threefry_partitionable), which makes in-process and subprocess lineages
+bit-comparable — verified by the equivalence asserts below.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from theanompi_tpu.resilience import EXIT_PREEMPTED, FaultInjected
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: one tiny config for every run in this file, so subprocess children share
+#: one compile-cache entry (1-core CI: compile time IS the test budget)
+TINY_CFG = {"depth": 10, "widen": 1, "batch_size": 4, "image_size": 8,
+            "n_train": 32, "n_val": 16, "n_epochs": 2, "precision": "fp32"}
+TINY_ARGS = ["--set", "depth=10", "--set", "widen=1", "--set", "batch_size=4",
+             "--set", "image_size=8", "--set", "n_train=32",
+             "--set", "n_val=16", "--set", "precision='fp32'"]
+
+
+def _child_env(**extra):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        # match the in-process session exactly (device topology changes
+        # XLA:CPU partitioning, the RNG flag changes every random stream)
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "JAX_THREEFRY_PARTITIONABLE": "true",
+        "PYTHONPATH": REPO,
+    })
+    env.pop("THEANOMPI_FAULT_PLAN", None)  # only ever injected explicitly
+    env.update(extra)
+    return env
+
+
+def _launcher_cmd(*args):
+    return [sys.executable, "-m", "theanompi_tpu.launcher",
+            "--rule", "BSP", "--devices", "4",
+            "--modelfile", "theanompi_tpu.models.wide_resnet",
+            "--modelclass", "WideResNet", *TINY_ARGS, "--quiet", *args]
+
+
+def _clean_run_inprocess(ckpt_dir, rule_cfg=None):
+    """The unfaulted reference lineage, trained in-process on mesh4."""
+    from theanompi_tpu import BSP
+
+    rule = BSP(config={"verbose": False, "checkpoint_dir": ckpt_dir,
+                       **(rule_cfg or {})})
+    rule.init(devices=4, modelfile="theanompi_tpu.models.wide_resnet",
+              modelclass="WideResNet", model_config=dict(TINY_CFG))
+    rule.wait()
+    return rule
+
+
+def _assert_ckpt_equal(path_a, path_b):
+    with np.load(path_a) as a, np.load(path_b) as b:
+        assert set(a.files) == set(b.files)
+        for k in a.files:
+            np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+@pytest.mark.faultinject
+def test_supervised_sigkill_restarts_and_resumes_equivalently(
+        tmp_path, subproc_compile_cache):
+    """THE acceptance scenario: a supervised run SIGKILLed mid-epoch-1
+    restarts, auto-resumes from latest.json, and finishes with params AND
+    val metrics bit-equal to an uninterrupted run at the same seed;
+    resilience.json reports the attempts and causes."""
+    clean_ck = str(tmp_path / "ck_clean")
+    rule = _clean_run_inprocess(clean_ck)
+    clean_val = {k: list(v) for k, v in
+                 rule.trainer.recorder.val_history.items()}
+
+    ck = str(tmp_path / "ck_fault")
+    rec = str(tmp_path / "rec_fault")
+    tel = str(tmp_path / "tel_fault")
+    p = subprocess.run(
+        _launcher_cmd("--set", "n_epochs=2",
+                      "--checkpoint-dir", ck, "--record-dir", rec,
+                      "--telemetry-dir", tel,
+                      "--compile-cache-dir", subproc_compile_cache,
+                      "--supervise", "--max-restarts", "3",
+                      "--backoff-base", "0.1"),
+        # kill at the entry of iteration 3 = one step INTO epoch 1 (two
+        # 2-step epochs), first attempt only — the restart must not re-die
+        env=_child_env(THEANOMPI_FAULT_PLAN="step:kill@3@1"),
+        cwd=REPO, capture_output=True, text=True, timeout=480)
+    assert p.returncode == 0, p.stderr[-2000:]
+
+    art = json.load(open(os.path.join(ck, "resilience.json")))
+    assert [a["cause"] for a in art["attempts"]] == ["crash", "clean"]
+    assert art["attempts"][0]["exit_code"] == -signal.SIGKILL
+    assert art["restarts"] == 1 and art["final_exit"] == 0
+    assert art["attempts"][0]["time_lost_s"] > 0
+    # the supervisor mirrors the attempt records into the telemetry dir
+    # (its own JSONL, not an events-rank sink a child attempt would
+    # truncate and rank-0 aggregation would misread)
+    sup_events = [json.loads(line) for line in
+                  open(os.path.join(tel, "supervisor.jsonl"))]
+    assert [e["cause"] for e in sup_events
+            if e["name"] == "supervisor.attempt"] == ["crash", "clean"]
+    assert any(e["name"] == "supervisor.done" for e in sup_events)
+    # the supervisor told the restarted child to resume, and it did:
+    # epoch 1 was replayed from the epoch-0 checkpoint, so the final
+    # lineage is bit-identical to the uninterrupted run
+    _assert_ckpt_equal(os.path.join(clean_ck, "ckpt_e0001.npz"),
+                       os.path.join(ck, "ckpt_e0001.npz"))
+    faulted_val = np.load(os.path.join(rec, "val_history.npy"),
+                          allow_pickle=True).item()
+    for k, v in clean_val.items():
+        np.testing.assert_array_equal(np.asarray(v), faulted_val[k],
+                                      err_msg=f"val history {k!r}")
+
+
+@pytest.mark.faultinject
+def test_crash_resume_equivalence_zero1(tmp_path):
+    """Crash-resume equivalence holds for the sharded-optimizer exchange
+    too (zero1's opt state lives in flat sharded buckets — the checkpoint
+    and restore path must round-trip them exactly).  In-process: the
+    supervised-subprocess machinery is already locked by the psum test."""
+    from theanompi_tpu import BSP
+
+    cfg = {"exch_strategy": "zero1"}
+    clean_ck = str(tmp_path / "ck_clean")
+    _clean_run_inprocess(clean_ck, rule_cfg=dict(cfg))
+
+    ck = str(tmp_path / "ck_fault")
+    rule = BSP(config={"verbose": False, "checkpoint_dir": ck,
+                       "fault_plan": "step:raise@3", **cfg})
+    rule.init(devices=4, modelfile="theanompi_tpu.models.wide_resnet",
+              modelclass="WideResNet", model_config=dict(TINY_CFG))
+    with pytest.raises(FaultInjected):
+        rule.wait()  # dies one step into epoch 1
+    # in-place resume (same process, compiled fns retained): restore the
+    # latest checkpoint and train to completion
+    assert rule.trainer.try_resume()
+    assert rule.trainer.epoch == 1  # epoch 0 published before the crash
+    rule.wait()
+    _assert_ckpt_equal(os.path.join(clean_ck, "ckpt_e0001.npz"),
+                       os.path.join(ck, "ckpt_e0001.npz"))
+
+
+@pytest.mark.faultinject
+def test_sentinel_skip_batch_device_guard(tmp_path):
+    """A NaN-poisoned batch costs one skipped update: params stay finite,
+    the run completes, the skip is counted against the bounded budget."""
+    from theanompi_tpu import BSP
+
+    rule = BSP(config={"verbose": False, "print_freq": 1,
+                       "fault_plan": "step:nan@1",
+                       "sentinel_policy": "skip_batch"})
+    rule.init(devices=2, modelfile="theanompi_tpu.models.wide_resnet",
+              modelclass="WideResNet", model_config=dict(TINY_CFG))
+    rule.wait()
+    t = rule.trainer
+    assert t.sentinel.skips == 1.0
+    assert t.epoch == TINY_CFG["n_epochs"]  # ran to completion
+    for leaf in jax.tree.leaves(t.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+@pytest.mark.faultinject
+def test_sentinel_rollback_reloads_checkpoint(tmp_path):
+    """rollback: a non-finite loss mid-epoch-1 reloads the epoch-0
+    checkpoint in-process and the run still completes (the transient —
+    one-shot by construction — does not recur on the replay)."""
+    from theanompi_tpu import BSP
+
+    ck = str(tmp_path / "ck")
+    rule = BSP(config={"verbose": False, "print_freq": 1,
+                       "fault_plan": "step:nan@5",
+                       "sentinel_policy": "rollback",
+                       "checkpoint_dir": ck})
+    # devices=2 -> 4 steps/epoch; nan at step 5 = epoch 1, step 2
+    rule.init(devices=2, modelfile="theanompi_tpu.models.wide_resnet",
+              modelclass="WideResNet", model_config=dict(TINY_CFG))
+    rule.wait()
+    t = rule.trainer
+    assert t.sentinel.rollbacks == 1
+    assert t.epoch == TINY_CFG["n_epochs"]
+    for leaf in jax.tree.leaves(t.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+@pytest.mark.faultinject
+def test_sigterm_mid_epoch_resumable_exit(tmp_path, subproc_compile_cache):
+    """SIGTERM mid-training -> final synchronous checkpoint + the distinct
+    EXIT_PREEMPTED code; a resumed run picks the lineage up and finishes."""
+    ck = str(tmp_path / "ck")
+    child = subprocess.Popen(
+        _launcher_cmd("--set", "n_epochs=200",  # far more than we let run
+                      "--checkpoint-dir", ck,
+                      "--compile-cache-dir", subproc_compile_cache,
+                      "--rule-set", "handle_preemption=True"),
+        env=_child_env(), cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.perf_counter() + 240
+        latest = os.path.join(ck, "latest.json")
+        while not os.path.exists(latest):
+            assert child.poll() is None, \
+                f"child died early: {child.stderr.read()[-2000:]}"
+            assert time.perf_counter() < deadline, "no checkpoint in 240s"
+            time.sleep(0.05)
+        time.sleep(0.3)  # let it get a step or two into the next epoch
+        child.send_signal(signal.SIGTERM)
+        rc = child.wait(timeout=120)
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+    err = child.stderr.read()
+    assert rc == EXIT_PREEMPTED, err[-2000:]
+    assert "tmlauncher: preempted" in err
+    meta = json.load(open(latest))
+    saved_epoch = meta["epoch"]
+
+    # resume in-process: the preemption checkpoint is a normal lineage
+    # point — training continues to (a shrunk) completion
+    from theanompi_tpu import BSP
+
+    rule = BSP(config={"verbose": False, "checkpoint_dir": ck,
+                       "resume": True})
+    rule.init(devices=4, modelfile="theanompi_tpu.models.wide_resnet",
+              modelclass="WideResNet",
+              model_config={**TINY_CFG, "n_epochs": saved_epoch + 2})
+    assert rule.trainer.epoch == saved_epoch + 1  # resumed, not fresh
+    rule.wait()
+    assert rule.trainer.epoch == saved_epoch + 2
+    assert json.load(open(latest))["epoch"] == saved_epoch + 1

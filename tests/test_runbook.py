@@ -88,6 +88,43 @@ def test_runbook_launcher_command(tmp_path):
                for f in os.listdir(ckpt))
 
 
+def test_runbook_supervised_command(tmp_path, monkeypatch,
+                                    subproc_compile_cache):
+    """RUNBOOK step 5's supervised launch (`--supervise --max-restarts 3`)
+    at toy scale: the supervisor parent runs in-process, the session runs
+    in a child process, and the resilience.json audit trail lands next to
+    the checkpoints (the exact flags BASELINE.md documents — ISSUE 4)."""
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=8")
+    monkeypatch.setenv("JAX_THREEFRY_PARTITIONABLE", "true")
+    monkeypatch.setenv("PYTHONPATH",
+                       repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    monkeypatch.delenv("THEANOMPI_FAULT_PLAN", raising=False)
+    assert sys.executable
+    ckpt = str(tmp_path / "ckpt")
+    rc = launcher.main([
+        "--rule", "BSP", "--devices", "4",
+        "--modelfile", "theanompi_tpu.models.wide_resnet",
+        "--modelclass", "WideResNet",
+        "--set", "depth=10", "--set", "widen=1", "--set", "batch_size=4",
+        "--set", "image_size=8", "--set", "n_train=32", "--set", "n_val=16",
+        "--set", "n_epochs=1", "--set", "precision='fp32'",
+        "--checkpoint-dir", ckpt,
+        "--compile-cache-dir", subproc_compile_cache,
+        "--supervise", "--max-restarts", "3", "--backoff-base", "0.5",
+        "--quiet",
+    ])
+    assert rc == 0
+    art = json.load(open(os.path.join(ckpt, "resilience.json")))
+    assert [a["cause"] for a in art["attempts"]] == ["clean"]
+    assert art["restarts"] == 0 and art["final_exit"] == 0
+    assert "latest.json" in os.listdir(ckpt)
+
+
 def test_runbook_exchange_bench_command(tmp_path):
     """The RUNBOOK's exchange-strategy comparison sidebar: the exact
     --exchange-bench CLI must run and emit the per-strategy artifact

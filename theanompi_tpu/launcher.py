@@ -30,6 +30,13 @@ import ast
 import json
 import os
 import sys
+import time
+
+
+class DistributedInitError(RuntimeError):
+    """The pod's multi-controller runtime could not be joined (after
+    retries) — a hard error, because training single-host while the other
+    hosts wait at a collective would hang the whole slice."""
 
 
 def _parse_kv(pairs: list[str]) -> dict:
@@ -47,17 +54,71 @@ def _parse_kv(pairs: list[str]) -> dict:
     return out
 
 
-def _maybe_init_distributed() -> None:
-    """Join the JAX multi-controller runtime on a pod (no-op on one host)."""
-    if os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get(
-        "JAX_COORDINATOR_ADDRESS"
-    ):
-        import jax
+def _maybe_init_distributed(retries: int | None = None,
+                            backoff_base: float | None = None,
+                            sleep=time.sleep) -> None:
+    """Join the JAX multi-controller runtime on a pod (no-op on one host).
 
+    ISSUE 4 satellite: a flaky coordinator used to be swallowed here,
+    silently downgrading a pod launch to single-host training.  Now init
+    is retried with bounded exponential backoff
+    (``THEANOMPI_DIST_INIT_RETRIES`` / ``THEANOMPI_DIST_INIT_BACKOFF``,
+    defaults 3 / 1s), and exhausting the retries while the pod env vars
+    are present raises :class:`DistributedInitError` — the supervisor
+    classifies that as a restartable crash, never a quiet downgrade.
+    An "already initialized" runtime (harness-managed) still short-circuits.
+    """
+    if not (os.environ.get("TPU_WORKER_HOSTNAMES")
+            or os.environ.get("JAX_COORDINATOR_ADDRESS")):
+        return
+    import jax
+
+    if retries is None:
+        retries = int(os.environ.get("THEANOMPI_DIST_INIT_RETRIES", "3"))
+    if backoff_base is None:
+        backoff_base = float(os.environ.get("THEANOMPI_DIST_INIT_BACKOFF",
+                                            "1.0"))
+    retries = max(1, retries)
+    last: Exception | None = None
+    for attempt in range(1, retries + 1):
         try:
             jax.distributed.initialize()
-        except (RuntimeError, ValueError) as e:  # already initialized / local
-            print(f"tmlauncher: distributed init skipped: {e}", file=sys.stderr)
+            return
+        except (RuntimeError, ValueError) as e:
+            msg = str(e).lower()
+            # double-init is fine (the harness beat us to it).  jax 0.4.37
+            # phrases it "distributed.initialize should only be called
+            # once."; older/newer versions say "already initialized".
+            # Match those SPECIFIC phrasings — a bare "already" would also
+            # swallow grpc's "Address already in use" (a stale coordinator
+            # port), which is a real failure that must retry/raise.
+            # And only on the FIRST attempt: jax assigns its global client
+            # BEFORE connect(), so after a failed attempt the retry raises
+            # this same message about the half-initialized carcass —
+            # honoring it then would silently report success on a runtime
+            # that never connected
+            if ("already initialized" in msg
+                    or "only be called once" in msg):
+                if attempt == 1:
+                    print(f"tmlauncher: distributed init skipped: {e}",
+                          file=sys.stderr)
+                    return
+            else:
+                last = e
+                print(f"tmlauncher: distributed init attempt "
+                      f"{attempt}/{retries} failed: {e}", file=sys.stderr)
+            try:
+                # clear the half-initialized global state so the retry is
+                # a real fresh initialize, not a double-init error
+                jax.distributed.shutdown()
+            except Exception:  # lint: swallow-ok — nothing to shut down
+                pass
+            if attempt < retries:
+                sleep(backoff_base * (2 ** (attempt - 1)))
+    raise DistributedInitError(
+        f"could not join the multi-controller runtime after {retries} "
+        f"attempts (pod env vars present, so a single-host fallback would "
+        f"desynchronize the slice): {last}")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -65,6 +126,11 @@ def build_parser() -> argparse.ArgumentParser:
         prog="tmlauncher",
         description="Launch a theanompi_tpu training session on the local "
         "mesh (run on every host of a pod for multi-host).",
+        # no prefix abbreviation: the supervisor strips its own flags from
+        # the child argv by exact spelling — an abbreviated '--superv'
+        # sneaking through would make the child a supervisor too
+        # (recursive spawning)
+        allow_abbrev=False,
     )
     p.add_argument("--rule", default="BSP",
                    choices=["BSP", "EASGD", "GOSGD", "LocalSGD"])
@@ -94,18 +160,146 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quiet", action="store_true")
+    sup = p.add_argument_group(
+        "supervision (ISSUE 4: auto-restart + resume)")
+    sup.add_argument("--supervise", action="store_true",
+                     help="run the session in a supervised child process: "
+                     "classify exits (crash/preemption/hang/config), "
+                     "restart with bounded exponential backoff and "
+                     "--resume, and write a resilience.json audit trail")
+    sup.add_argument("--max-restarts", type=int, default=3,
+                     help="crash/hang restart budget (preemption exits are "
+                     "budget-free); default 3")
+    sup.add_argument("--backoff-base", type=float, default=1.0,
+                     help="first restart delay in seconds, doubling per "
+                     "restart (jittered, capped); default 1.0")
+    sup.add_argument("--hang-timeout", type=float, default=None,
+                     help="supervisor-side heartbeat-staleness kill switch "
+                     "in seconds (backstop for a child too wedged to run "
+                     "its own watchdog; off by default)")
+    p.add_argument("--sentinel", default=None,
+                   choices=["abort", "skip_batch", "rollback"],
+                   help="non-finite loss/grad guard policy (shorthand for "
+                   "--rule-set sentinel_policy=...); off when absent")
     return p
 
 
-def main(argv: list[str] | None = None) -> int:
-    args = build_parser().parse_args(argv)
-    _maybe_init_distributed()
-    if args.compile_cache_dir:
-        # before the first jit dispatch (rule.init compiles lazily later)
-        from theanompi_tpu.parallel.mesh import setup_compile_cache
+#: supervision-layer flags stripped from the child's command line
+#: (value = how many operands follow the flag)
+_SUPERVISOR_FLAGS = {"--supervise": 0, "--max-restarts": 1,
+                     "--backoff-base": 1, "--hang-timeout": 1}
 
-        setup_compile_cache(args.compile_cache_dir)
 
+def _strip_supervision_args(argv: list[str]) -> list[str]:
+    out, i = [], 0
+    while i < len(argv):
+        key = argv[i].split("=", 1)[0]
+        if key in _SUPERVISOR_FLAGS:
+            i += 1
+            if "=" not in argv[i - 1]:
+                i += _SUPERVISOR_FLAGS[key]
+            continue
+        out.append(argv[i])
+        i += 1
+    return out
+
+
+def _supervisor_heartbeat_path(args, base: str) -> str:
+    """The supervisor must watch the SAME file the child writes: a
+    ``heartbeat_path`` rule key overrides the ``THEANOMPI_HEARTBEAT`` env
+    in the child, so honor it here too — a mismatch would make
+    ``--hang-timeout`` kill every healthy child as silent."""
+    try:
+        _, rule_config = _build_configs(args)
+    except Exception:  # lint: swallow-ok — the child will report it
+        rule_config = {}
+    return (rule_config.get("heartbeat_path")
+            or os.path.join(base, "heartbeat.json"))
+
+
+def _supervise(argv: list[str], args) -> int:
+    """The --supervise path: this process becomes the Supervisor; the
+    actual session runs in child launcher processes (a fresh process is
+    the only thing a SIGKILL/OOM/wedged-runtime can't take down with it,
+    and the only way to re-init a jax backend cleanly)."""
+    from theanompi_tpu.resilience import EXIT_CONFIG, Supervisor, supervised
+
+    if supervised():
+        # belt-and-braces recursion guard: a supervised child must never
+        # itself supervise (argv stripping should prevent this; if it ever
+        # leaks through, fail loudly instead of forking forever)
+        print("tmlauncher: error: config: --supervise inside a supervised "
+              "child (recursive supervision)", file=sys.stderr, flush=True)
+        return EXIT_CONFIG
+
+    base = args.checkpoint_dir or "."
+    os.makedirs(base, exist_ok=True)
+    if not args.checkpoint_dir:
+        print("tmlauncher: warning: --supervise without --checkpoint-dir — "
+              "restarts will redo all work (nothing to resume from)",
+              file=sys.stderr)
+    heartbeat = _supervisor_heartbeat_path(args, base)
+    child = ([sys.executable, "-m", "theanompi_tpu.launcher"]
+             + _strip_supervision_args(argv))
+    sup = Supervisor(
+        child,
+        max_restarts=args.max_restarts,
+        backoff_base=args.backoff_base,
+        hang_timeout_s=args.hang_timeout,
+        heartbeat_path=heartbeat,
+        resilience_path=os.path.join(base, "resilience.json"),
+        telemetry_dir=args.telemetry_dir,
+        seed=args.seed,
+    )
+    return sup.run()
+
+
+def _compile_cache_usable(args) -> bool:
+    """Work around a jaxlib 0.4.3x CPU-backend bug found while building the
+    supervisor (ISSUE 4): loading persistent-compilation-cache executables
+    into a *resumed* session intermittently corrupts the native heap
+    (malloc "invalid next size" / SIGSEGV under load — reproduced only
+    with the resume + warm-cache combination; fresh runs reading the
+    cache and resumed runs writing a cold cache are both fine).  Until
+    the toolchain moves, a resumed CPU-backend session skips the cache
+    and repays the compile; TPU backends (a different executable
+    serialization path) keep it.  ``THEANOMPI_RESUME_COMPILE_CACHE=1``
+    forces the cache back on, ``=0`` forces it off everywhere.
+    """
+    if not args.resume:
+        return True
+    force = os.environ.get("THEANOMPI_RESUME_COMPILE_CACHE")
+    if force is not None:
+        return force.strip().lower() not in ("0", "false", "no", "off", "")
+    import jax
+
+    if jax.default_backend() != "cpu":
+        return True
+    print("tmlauncher: compile cache disabled for this resumed CPU-backend "
+          "session (jaxlib 0.4.3x cache-load instability; "
+          "THEANOMPI_RESUME_COMPILE_CACHE=1 forces it on)", file=sys.stderr)
+    return False
+
+
+def _error_line(phase: str, e: BaseException) -> None:
+    """The one-line exit-code-contract error report (ISSUE 4 satellite):
+    no raw traceback unless THEANOMPI_DEBUG asks for one."""
+    print(f"tmlauncher: error: {phase}: {type(e).__name__}: {e}",
+          file=sys.stderr, flush=True)
+    if os.environ.get("THEANOMPI_DEBUG"):
+        import traceback
+
+        traceback.print_exc()
+
+
+#: setup-phase exception types that will not fix themselves on restart
+_CONFIG_ERRORS = (ImportError, AttributeError, TypeError, ValueError,
+                  KeyError, IndexError, FileNotFoundError,
+                  IsADirectoryError, NotADirectoryError,
+                  json.JSONDecodeError)
+
+
+def _build_configs(args) -> tuple[dict, dict]:
     model_config: dict = {}
     rule_config: dict = {}
     if args.config_json:
@@ -122,24 +316,88 @@ def main(argv: list[str] | None = None) -> int:
         rule_config["telemetry_dir"] = args.telemetry_dir
     if args.checkpoint_dir:
         rule_config["checkpoint_dir"] = args.checkpoint_dir
+    if args.sentinel:
+        rule_config.setdefault("sentinel_policy", args.sentinel)
     if args.resume:
         rule_config["resume"] = True
     if args.quiet:
         rule_config["verbose"] = False
+    return model_config, rule_config
 
-    import theanompi_tpu
 
-    rule_cls = getattr(theanompi_tpu, args.rule)
-    devices = None if args.devices == "all" else int(args.devices)
+def main(argv: list[str] | None = None) -> int:
+    """Exit-code contract (ISSUE 4; see the README table): 0 clean,
+    70 training crash, 75 resumable preemption exit, 76 watchdog hang,
+    78 config error — each reported as ONE ``tmlauncher: ...`` stderr line
+    (set THEANOMPI_DEBUG=1 for the full traceback), so the supervisor —
+    and any outer scheduler — can classify without parsing tracebacks."""
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    args = build_parser().parse_args(argv)
+    if args.supervise:
+        return _supervise(argv, args)
 
-    rule = rule_cls(config=rule_config)
-    rule.init(
-        devices=devices,
-        modelfile=args.modelfile,
-        modelclass=args.modelclass,
-        model_config=model_config,
+    from theanompi_tpu.resilience import (
+        EXIT_CONFIG,
+        EXIT_CRASH,
+        EXIT_PREEMPTED,
+        PreemptionExit,
     )
-    recorder = rule.wait()
+
+    # -- config phase: wrong flags/files will not fix themselves ------------
+    try:
+        model_config, rule_config = _build_configs(args)
+        import theanompi_tpu
+
+        rule_cls = getattr(theanompi_tpu, args.rule)
+        devices = None if args.devices == "all" else int(args.devices)
+    except SystemExit as e:  # _parse_kv-style one-line config rejections
+        print(f"tmlauncher: error: config: {e}", file=sys.stderr, flush=True)
+        return EXIT_CONFIG
+    except Exception as e:
+        _error_line("config", e)
+        return EXIT_CONFIG
+
+    # -- environment phase: transient by nature, restartable ----------------
+    try:
+        _maybe_init_distributed()
+        if args.compile_cache_dir and _compile_cache_usable(args):
+            # before the first jit dispatch (rule.init compiles lazily)
+            from theanompi_tpu.parallel.mesh import setup_compile_cache
+
+            setup_compile_cache(args.compile_cache_dir)
+    except Exception as e:
+        _error_line("distributed init", e)
+        return EXIT_CRASH
+
+    # -- init phase: model import / mesh build / compile / resume ----------
+    try:
+        rule = rule_cls(config=rule_config)
+        rule.init(
+            devices=devices,
+            modelfile=args.modelfile,
+            modelclass=args.modelclass,
+            model_config=model_config,
+        )
+    except _CONFIG_ERRORS as e:
+        _error_line("init", e)
+        return EXIT_CONFIG
+    except Exception as e:
+        _error_line("init", e)
+        return EXIT_CRASH
+
+    # -- training phase -----------------------------------------------------
+    try:
+        recorder = rule.wait()
+    except PreemptionExit as e:
+        print(f"tmlauncher: preempted: {e} (exit {EXIT_PREEMPTED}; rerun "
+              f"with --resume or under --supervise)", file=sys.stderr,
+              flush=True)
+        return EXIT_PREEMPTED
+    except KeyboardInterrupt:
+        raise  # a human's ^C is not a crash to classify
+    except Exception as e:
+        _error_line("training", e)
+        return EXIT_CRASH
     if not args.quiet:
         last = {k: v[-1] for k, v in recorder.val_history.items() if v}
         print(f"tmlauncher: done. final val: {last}", flush=True)

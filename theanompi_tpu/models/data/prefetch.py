@@ -26,24 +26,44 @@ from theanompi_tpu.utils.helper_funcs import shard_batch
 _END = object()
 
 
+class PrefetchStallError(RuntimeError):
+    """The source iterator produced nothing for ``stall_timeout`` seconds
+    (ISSUE 4): the training thread gets a diagnosable error instead of a
+    silent eternal block in ``queue.get`` — which a supervisor can restart
+    and a watchdog would otherwise only catch by its coarser no-progress
+    threshold."""
+
+
 class Prefetcher:
     """Iterate ``it`` on a daemon thread, ``depth`` batches ahead.
 
     ``mesh`` set → batches are shard_batch'd (device transfer included in the
     overlap) and arrive as jax arrays; ``mesh=None`` → raw host batches.
     An exception in the source iterator is re-raised at the consuming site.
+
+    ``stall_timeout`` (seconds, default None = block forever as before)
+    bounds how long ``__next__`` waits on an empty queue before raising
+    :class:`PrefetchStallError`.  ``fault_plan`` enables the deterministic
+    ``prefetch:stall@N`` / ``prefetch:raise@N`` injection sites inside the
+    worker (N = source batch ordinal).
     """
 
     def __init__(self, it, mesh=None, depth: int = 2, spec=None,
-                 telemetry=None):
+                 telemetry=None, stall_timeout: float | None = None,
+                 fault_plan=None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        if stall_timeout is not None and stall_timeout <= 0:
+            raise ValueError(
+                f"prefetch stall_timeout must be positive or None, "
+                f"got {stall_timeout}")
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._it = it
         # optional telemetry: each dequeue emits a span with the residual
         # queue depth, so a starving pipeline is visible in the trace as
         # long prefetch.dequeue spans at qsize 0
         self._telemetry = telemetry
+        self._stall_timeout = stall_timeout
         self._err: BaseException | None = None
         self._stop = threading.Event()
 
@@ -59,7 +79,22 @@ class Prefetcher:
 
         def work():
             try:
-                for item in it:
+                for i, item in enumerate(it):
+                    if fault_plan is not None:
+                        action = fault_plan.fire("prefetch", i)
+                        if action == "stall":
+                            # a hung source: produce nothing until closed
+                            # (the consumer's stall_timeout is the witness)
+                            while not self._stop.wait(0.05):
+                                pass
+                            return
+                        if action == "raise":
+                            from theanompi_tpu.resilience.faults import (
+                                FaultInjected,
+                            )
+
+                            raise FaultInjected(
+                                f"injected source failure at batch {i}")
                     if self._stop.is_set():
                         return
                     if mesh is not None:
@@ -77,10 +112,31 @@ class Prefetcher:
     def __iter__(self):
         return self
 
+    def _get(self):
+        """Dequeue honoring ``stall_timeout`` (None = block forever)."""
+        if self._stall_timeout is None:
+            return self._q.get()
+        deadline = time.perf_counter() + self._stall_timeout
+        while True:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                if self._telemetry is not None:
+                    self._telemetry.instant(
+                        "prefetch.stall", timeout_s=self._stall_timeout)
+                raise PrefetchStallError(
+                    f"no batch from the source iterator for "
+                    f"{self._stall_timeout:g}s (loader thread alive: "
+                    f"{self._thread.is_alive()}) — data pipeline stalled")
+            try:
+                # short slices so a concurrent close() is noticed promptly
+                return self._q.get(timeout=min(0.25, remaining))
+            except queue.Empty:
+                continue
+
     def __next__(self):
         tel = self._telemetry
         t0 = time.perf_counter() if tel is not None else 0.0
-        item = self._q.get()
+        item = self._get()
         if item is _END:
             self._thread.join()
             if self._err is not None:
@@ -127,10 +183,12 @@ class Prefetcher:
             close()
 
 
-def prefetch(it, mesh=None, depth: int = 2, spec=None, telemetry=None):
+def prefetch(it, mesh=None, depth: int = 2, spec=None, telemetry=None,
+             stall_timeout: float | None = None, fault_plan=None):
     """``depth=0`` disables prefetching (pass-through), else wraps in a
     :class:`Prefetcher`."""
     if depth == 0:
         return it
     return Prefetcher(it, mesh=mesh, depth=depth, spec=spec,
-                      telemetry=telemetry)
+                      telemetry=telemetry, stall_timeout=stall_timeout,
+                      fault_plan=fault_plan)

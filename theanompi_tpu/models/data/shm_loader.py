@@ -185,5 +185,5 @@ class ShmShardPool:
         try:
             self._shm.close()
             self._shm.unlink()
-        except FileNotFoundError:
+        except FileNotFoundError:  # lint: swallow-ok — already unlinked
             pass

@@ -39,7 +39,7 @@ def lib():
         _tried = True
         try:
             _lib = _build_and_load()
-        except Exception:
+        except Exception:  # lint: swallow-ok — optional native fast path
             _lib = None
         return _lib
 

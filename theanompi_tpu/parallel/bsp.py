@@ -21,7 +21,13 @@ import jax
 from jax.sharding import PartitionSpec as P
 
 from theanompi_tpu.parallel.exchanger import Exchanger
-from theanompi_tpu.parallel.mesh import DATA_AXIS, shard_map
+from theanompi_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    shard_map,
+)
 from theanompi_tpu.parallel.trainer import (
     BaseTrainer,
     Rule,
@@ -89,9 +95,27 @@ class BSPTrainer(BaseTrainer):
     def compile_iter_fns(self) -> None:
         """Build + jit the train/eval steps (reference method name)."""
         pspecs, sspecs, ospecs = self._spec_trees()
+        sentinel_skip = self.sentinel is not None and self.sentinel.device_guard
+        if sentinel_skip:
+            # the guard's finite-indicator psums over the EXCHANGE axes
+            # only; a sharded model/seq/pipe axis outside them could leave
+            # shards selecting different branches — refuse rather than
+            # silently diverge
+            exch_axes = (self.exchanger.axis_name
+                         if isinstance(self.exchanger.axis_name, tuple)
+                         else (self.exchanger.axis_name,))
+            for axis in (MODEL_AXIS, SEQ_AXIS, PIPE_AXIS):
+                if self.mesh.shape.get(axis, 1) > 1 and axis not in exch_axes:
+                    raise ValueError(
+                        f"sentinel_policy 'skip_batch' is data-parallel "
+                        f"only: mesh axis {axis!r} has size "
+                        f"{self.mesh.shape[axis]} outside the exchange axes "
+                        f"{exch_axes} (use 'abort' or 'rollback')"
+                    )
         local_step = make_local_step(
             self.model, self.optimizer, jax.random.PRNGKey(self.seed),
             exchanger=self.exchanger, param_specs=pspecs,
+            sentinel_skip=sentinel_skip,
         )
         local_eval = make_local_eval(self.model, axes=self.exchanger.axis_name)
 

@@ -95,6 +95,10 @@ class EASGDTrainer(BaseTrainer):
         local_step = make_local_step(
             self.model, self.optimizer, jax.random.PRNGKey(self.seed),
             stacked=True,
+            # per-worker guard (no exchanger => no cross-worker reduction,
+            # which matches the rule: params are per-worker divergent)
+            sentinel_skip=(self.sentinel is not None
+                           and self.sentinel.device_guard),
         )
         local_eval = make_local_eval(self.model)
 

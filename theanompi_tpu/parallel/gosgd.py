@@ -128,6 +128,10 @@ class GOSGDTrainer(BaseTrainer):
         local_step = make_local_step(
             self.model, self.optimizer, jax.random.PRNGKey(self.seed),
             stacked=True,
+            # per-worker guard, same reasoning as EASGD (params diverge by
+            # design, so a per-worker skip cannot desynchronize anything)
+            sentinel_skip=(self.sentinel is not None
+                           and self.sentinel.device_guard),
         )
         local_eval = make_local_eval(self.model)
         n = self.n_workers

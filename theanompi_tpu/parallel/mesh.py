@@ -104,7 +104,7 @@ def setup_compile_cache(directory: str | None = None,
             # jax 0.4.30 on; older jax simply keeps its default)
             jax.config.update("jax_persistent_cache_min_entry_size_bytes",
                               -1)
-        except AttributeError:
+        except AttributeError:  # lint: swallow-ok — version-compat probe
             pass
     try:
         # jax latches "no cache" at the first compile that ran before this
@@ -114,7 +114,7 @@ def setup_compile_cache(directory: str | None = None,
         from jax._src import compilation_cache
 
         compilation_cache.reset_cache()
-    except Exception:
+    except Exception:  # lint: swallow-ok
         pass  # private surface: a moved symbol must not break the launcher
     return directory
 

@@ -16,6 +16,7 @@ supplies the compiled step + parameter layout:
 
 from __future__ import annotations
 
+import sys
 import time
 from contextlib import nullcontext
 from typing import Any
@@ -24,6 +25,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+from theanompi_tpu.resilience import (
+    NonFiniteLossError,
+    PreemptGuard,
+    PreemptionExit,
+    PreemptionRequested,
+    ResilienceConfig,
+    SentinelRollback,
+)
 
 from theanompi_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -63,7 +73,7 @@ def restack(tree):
 
 
 def make_local_step(model, opt, base_key, exchanger=None, stacked=False,
-                    param_specs=None):
+                    param_specs=None, sentinel_skip=False):
     """The per-worker train step shared by every rule.
 
     ``exchanger`` set (BSP): gradients are mean-reduced across the data axis
@@ -103,6 +113,12 @@ def make_local_step(model, opt, base_key, exchanger=None, stacked=False,
             f"exch_strategy 'zero1' requires the standard grad step; "
             f"{type(model).__name__} supplies make_custom_step"
         )
+    if inner is not None and sentinel_skip:
+        raise ValueError(
+            f"sentinel policy 'skip_batch' requires the standard grad step; "
+            f"{type(model).__name__} supplies make_custom_step "
+            f"(use sentinel_policy='abort' or 'rollback')"
+        )
 
     def local_step(params, state, opt_state, batch, lr, step):
         if stacked:
@@ -131,6 +147,25 @@ def make_local_step(model, opt, base_key, exchanger=None, stacked=False,
                 new_state, metrics, grads = _accumulated_grads(
                     model, params, state, batch, rng, n_subb
                 )
+            ok = None
+            if sentinel_skip:
+                # the non-finite guard (ISSUE 4): ok iff loss AND the local
+                # grad-norm² are finite on EVERY worker — the indicator is
+                # psum'd across the exchange axes so replicas select the
+                # same branch (critical for zero1, whose local grads may be
+                # non-finite on only one shard)
+                gsq = jnp.float32(0)
+                for g in jax.tree.leaves(grads):
+                    if jnp.issubdtype(g.dtype, jnp.inexact):
+                        gsq = gsq + jnp.sum(jnp.square(g.astype(jnp.float32)))
+                bad = jnp.logical_not(jnp.isfinite(gsq)).astype(jnp.float32)
+                c = metrics.get("cost") if isinstance(metrics, dict) else None
+                if c is not None:
+                    bad = jnp.maximum(bad, jnp.logical_not(
+                        jnp.all(jnp.isfinite(c))).astype(jnp.float32))
+                if exchanger is not None:
+                    bad = jax.lax.psum(bad, exchanger.axis_name)
+                ok = bad == 0
             if exchanger is not None and exchanger.fuses_update:
                 # zero1: the exchange IS the update — reduce-scatter grad
                 # buckets, shard-local optimizer step, all-gather params
@@ -148,6 +183,21 @@ def make_local_step(model, opt, base_key, exchanger=None, stacked=False,
                 new_params, new_opt_state = opt.update(
                     grads, opt_state, params, lr, param_specs=param_specs
                 )
+            if ok is not None:
+                # skip_batch: a poisoned step costs one skipped update —
+                # keep the old params/state/opt state wholesale
+                def keep(new, old):
+                    return jax.tree.map(lambda a, b: jnp.where(ok, a, b),
+                                        new, old)
+
+                new_params = keep(new_params, params)
+                new_opt_state = keep(new_opt_state, opt_state)
+                new_state = keep(new_state, state)
+                if isinstance(metrics, dict):
+                    # the host-side Sentinel pops this flag and enforces
+                    # the bounded skip budget at fenced boundaries
+                    metrics = dict(metrics)
+                    metrics["_sentinel_skip"] = 1.0 - ok.astype(jnp.float32)
         if stacked:
             return (
                 restack(new_params),
@@ -283,7 +333,8 @@ class BaseTrainer:
                  checkpoint_async: bool = True,
                  profile_dir: str | None = None,
                  profile_window: tuple[int, int] = (10, 20),
-                 telemetry=None):
+                 telemetry=None,
+                 resilience: ResilienceConfig | None = None):
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh(n_data=1)
         self.n_workers = self.mesh.shape[DATA_AXIS]
@@ -291,6 +342,17 @@ class BaseTrainer:
         self.seed = seed
         self.prefetch_depth = prefetch_depth
         self.batch_spec = model.batch_partition()
+        # ISSUE 4 resilience: a default config is all-off (env-gated by the
+        # supervisor), so a bare trainer behaves exactly as before — every
+        # hot-path hook below guards on `is None`
+        self.resilience = (resilience if resilience is not None
+                           else ResilienceConfig())
+        self.fault_plan = self.resilience.build_fault_plan()
+        self.sentinel = self.resilience.build_sentinel(telemetry)
+        self._watchdog = None
+        self._heartbeat = None  # liveness-only writer when detector is off
+        self._preempt_guard = None
+        self._epoch_start_iter = 0
         self.checkpointer = None
         if checkpoint_dir:
             from theanompi_tpu.utils.checkpoint import Checkpointer
@@ -299,7 +361,8 @@ class BaseTrainer:
             # snapshot; serialization/publish/prune run on the writer
             self.checkpointer = Checkpointer(
                 checkpoint_dir, keep=checkpoint_keep,
-                async_save=checkpoint_async, telemetry=telemetry)
+                async_save=checkpoint_async, telemetry=telemetry,
+                fault_plan=self.fault_plan)
         self.optimizer = model.build_optimizer()
         self.global_batch = model.batch_size * self.n_workers
         self._step_fn = None
@@ -610,7 +673,32 @@ class BaseTrainer:
         tel.flush_metrics(step=self.iteration, window_steps=r.print_freq)
 
     # -- iteration (reference train_iter/val_iter) ---------------------------
+    def _apply_step_fault(self, batch):
+        """Deterministic fault injection (ISSUE 4) — the `step` site."""
+        from theanompi_tpu.resilience import faults
+
+        action = self.fault_plan.fire("step", self.iteration)
+        if action is None:
+            return batch
+        if action == "raise":
+            raise faults.FaultInjected(
+                f"injected failure at train step {self.iteration}")
+        if action == "kill":
+            faults.kill_self()
+        # "nan": poison the batch's float leaves so the loss/grads become
+        # genuinely non-finite — the sentinel sees the real article, not a
+        # spoofed metric
+        def poison(x):
+            dt = getattr(x, "dtype", None)
+            if dt is not None and jnp.issubdtype(dt, jnp.inexact):
+                return x * np.dtype(dt).type(float("nan"))
+            return x
+
+        return jax.tree.map(poison, batch)
+
     def train_iter(self, batch: dict, lr: float, recorder: Recorder | None = None):
+        if self.fault_plan is not None:
+            batch = self._apply_step_fault(batch)
         self._profile_tick()
         r = recorder or self.recorder
         tel = self.telemetry
@@ -646,6 +734,10 @@ class BaseTrainer:
             self._step_dev, self._step_dev_iter = nxt, self.iteration
         else:  # stacked/custom metrics carry no counter: re-place next call
             self._step_dev = None
+        # the device guard's skip flag is sentinel bookkeeping, not a
+        # training metric — pop it before the recorder sees the dict
+        skipf = (metrics.pop("_sentinel_skip", None)
+                 if isinstance(metrics, dict) else None)
         # fence only at print boundaries: per-iter blocking would serialize
         # the dispatch pipeline (SURVEY.md §7 hard part 5)
         fence = metrics["cost"] if self.iteration % r.print_freq == 0 else None
@@ -694,6 +786,22 @@ class BaseTrainer:
                     self._peak_flops = tmetrics.peak_flops()
             if self.iteration % r.print_freq == 0:
                 self._telemetry_flush(r)
+        if self._watchdog is not None:
+            self._watchdog.beat(self.iteration)
+        elif self._heartbeat is not None:
+            # detector disabled but a supervisor watches the file: keep
+            # proving liveness or its --hang-timeout kills a healthy run
+            self._heartbeat.beat(self.iteration)
+        if self.sentinel is not None:
+            # lazy refs now, materialization at the fenced print boundary:
+            # the sentinel must not add a per-step device sync (same
+            # discipline as the recorder's calc fence)
+            self.sentinel.watch(
+                step_idx,
+                metrics.get("cost") if isinstance(metrics, dict) else None,
+                skipf)
+            if self.iteration % r.print_freq == 0:
+                self.sentinel.check()
         return metrics
 
     def val_iter(self, batch: dict, recorder: Recorder | None = None,
@@ -753,24 +861,85 @@ class BaseTrainer:
             depth=self.prefetch_depth,
             spec=self.batch_spec,
             telemetry=self.telemetry,
+            # ISSUE 4: a hung source raises PrefetchStallError instead of
+            # deadlocking the training thread forever (None keeps the old
+            # block-forever behavior); the fault plan's `prefetch` site
+            # lives inside the worker
+            stall_timeout=self.resilience.prefetch_stall_timeout,
+            fault_plan=self.fault_plan,
         )
 
-    def run(self, stop=None):
-        """Train to completion.
+    def _check_preempt(self) -> None:
+        """Between-steps preemption poll (a host flag read, nothing more)."""
+        if self._preempt_guard is not None and self._preempt_guard.triggered:
+            raise PreemptionRequested()
 
-        ``stop``: optional ``(epoch, val_metrics) -> bool`` checked after each
-        epoch's validation — a True ends training early (used by the
-        rule-comparison harness for train-to-target runs).
+    def _preemption_checkpoint(self) -> bool:
+        """The final synchronous checkpoint of a preempted run.
+
+        The state is labeled with the last *completed* epoch and that
+        epoch's boundary iteration, so the resume machinery is untouched:
+        a resumed run replays the interrupted epoch from its start with
+        the mid-epoch params (steps already taken train again — at-least-
+        once epoch semantics, never a lost or inconsistent state).  When
+        no step has run since the last boundary save there is nothing new
+        to capture; the in-flight async writer (if any) is joined so the
+        boundary checkpoint is durably published before exiting.
         """
-        if self._step_fn is None:
-            self.compile_iter_fns()
-        if self.params is None:
-            self.init_state()
+        if self.checkpointer is None:
+            return False
+        if self.iteration <= self._epoch_start_iter:
+            self.checkpointer.join_pending()
+            return False
+        label = self.epoch - 1  # the current epoch is in progress
+        if label < 0:
+            return False  # mid-first-epoch: resume simply starts fresh
+        handle = self.checkpointer.save(
+            label, self._epoch_start_iter, self.checkpoint_trees(),
+            recorder_snapshot=self.recorder.history_snapshot())
+        handle.join()  # synchronous: the process is about to exit
+        self.checkpointer.join_pending()
+        return True
+
+    def _handle_rollback(self, e: SentinelRollback) -> None:
+        """Reload the latest checkpoint in-process (sentinel 'rollback')."""
+        self.sentinel.rollbacks += 1
+        latest = (self.checkpointer.latest_epoch()
+                  if self.checkpointer is not None else None)
+        if latest is None or self.sentinel.rollbacks > self.sentinel.max_rollbacks:
+            why = ("no checkpoint to roll back to" if latest is None else
+                   f"rollback budget exhausted "
+                   f"({self.sentinel.max_rollbacks})")
+            raise NonFiniteLossError(
+                f"non-finite loss at step {e.step}; {why}", step=e.step
+            ) from e
+        print(f"sentinel: non-finite loss at step {e.step}; rolling back "
+              f"to checkpoint epoch {latest} "
+              f"({self.sentinel.rollbacks}/{self.sentinel.max_rollbacks})",
+              file=sys.stderr, flush=True)
+        if self.telemetry is not None:
+            self.telemetry.instant("sentinel.rollback", step=e.step,
+                                   restore_epoch=latest,
+                                   rollback=self.sentinel.rollbacks)
+        self.sentinel.reset_pending()  # pending losses describe a dead timeline
+        if self._watchdog is not None:
+            self._watchdog.pause()  # restore I/O + re-placement is beat-free
+        try:
+            self.try_resume()
+        finally:
+            if self._watchdog is not None:
+                self._watchdog.resume()
+        self._step_dev = None  # restored iteration needs a fresh device scalar
+
+    def _run_epochs(self, stop=None) -> None:
+        """The epoch loop proper (run() owns retry/teardown around it)."""
         model = self.model
         batches = None
         try:
             for epoch in range(self.epoch, model.n_epochs):
                 self.epoch = epoch
+                self._epoch_start_iter = self.iteration
+                self._check_preempt()
                 self.recorder.start_epoch()
                 lr = model.adjust_hyperp(epoch)
                 if batches is None:  # not pre-built at the last boundary
@@ -792,6 +961,7 @@ class BaseTrainer:
                             break
                         self.recorder.end("wait")
                         self.train_iter(batch, lr)
+                        self._check_preempt()
                 finally:
                     # a step failure must not leave the loader thread pinning
                     # device batches
@@ -799,21 +969,44 @@ class BaseTrainer:
                     if close is not None:
                         close()
                     batches = None
-                # epoch-boundary overlap (ISSUE 3): build the NEXT epoch's
-                # prefetcher BEFORE validate + checkpoint, so its loader
-                # thread refills the input queue while the host validates
-                # and the checkpoint writer runs — the first post-boundary
-                # step no longer starts on a cold queue (its 'wait' segment
-                # is the witness)
-                if epoch + 1 < model.n_epochs:
-                    batches = self._make_prefetcher(epoch + 1)
-                val = self.validate(epoch)
-                self.save_checkpoint(epoch)
+                # boundary work is beat-free by nature (validation's first
+                # eval compile, the val sweep, checkpoint joins): suspend
+                # stall detection or a long boundary reads as a hang
+                if self._watchdog is not None:
+                    self._watchdog.pause()
+                elif self._heartbeat is not None:
+                    self._heartbeat.beat(self.iteration, force=True)
+                try:
+                    if self.sentinel is not None:
+                        # enforce pending observations BEFORE the boundary
+                        # checkpoint: a state the policy rejects must never
+                        # be the published resume point
+                        self.sentinel.check()
+                    # epoch-boundary overlap (ISSUE 3): build the NEXT
+                    # epoch's prefetcher BEFORE validate + checkpoint, so
+                    # its loader thread refills the input queue while the
+                    # host validates and the checkpoint writer runs — the
+                    # first post-boundary step no longer starts on a cold
+                    # queue (its 'wait' segment is the witness)
+                    if epoch + 1 < model.n_epochs:
+                        batches = self._make_prefetcher(epoch + 1)
+                    val = self.validate(epoch)
+                    self.save_checkpoint(epoch)
+                finally:
+                    if self._watchdog is not None:
+                        self._watchdog.resume()
+                    elif self._heartbeat is not None:
+                        self._heartbeat.beat(self.iteration, force=True)
+                # progress up to here is durably labeled: a preemption
+                # arriving before the next step must not re-save (and must
+                # not regress the published iteration)
+                self._epoch_start_iter = self.iteration
                 if self.telemetry is not None:
                     # restart the rate window: validation + checkpoint time
                     # must not deflate the next examples/s gauge
                     self._last_metrics_flush = None
                 self.epoch = epoch + 1  # resume point: next, not this one
+                self._check_preempt()
                 if stop is not None and stop(epoch, val):
                     break
         finally:
@@ -823,6 +1016,70 @@ class BaseTrainer:
                 close = getattr(batches, "close", None)
                 if close is not None:
                     close()
+
+    def run(self, stop=None):
+        """Train to completion.
+
+        ``stop``: optional ``(epoch, val_metrics) -> bool`` checked after each
+        epoch's validation — a True ends training early (used by the
+        rule-comparison harness for train-to-target runs).
+
+        Resilience (ISSUE 4, all opt-in — see the resilience package):
+        a sentinel 'rollback' reloads the latest checkpoint in-process and
+        retries; a preemption signal lands as a final synchronous
+        checkpoint plus a :class:`PreemptionExit` carrying the distinct
+        resumable exit code; a watchdog thread (under supervision) turns a
+        stalled loop into a restartable hang exit.
+        """
+        if self._step_fn is None:
+            self.compile_iter_fns()
+        if self.params is None:
+            self.init_state()
+        model = self.model
+        guard = None
+        if self.resilience.preemption_enabled():
+            guard = PreemptGuard(telemetry=self.telemetry)
+            if not guard.install():  # not the main thread: stay inactive
+                guard = None
+        self._preempt_guard = guard
+        self._watchdog = self.resilience.build_watchdog(self.telemetry)
+        if self._watchdog is not None:
+            self._watchdog.start()
+        else:
+            self._heartbeat = self.resilience.build_heartbeat()
+        try:
+            while True:
+                try:
+                    self._run_epochs(stop)
+                    break
+                except SentinelRollback as e:
+                    self._handle_rollback(e)  # may escalate NonFiniteLossError
+        except PreemptionRequested:
+            if self._watchdog is not None:
+                # the final synchronous checkpoint is beat-free and must
+                # not be killed as a hang (76 would burn restart budget;
+                # this exit is the budget-free 75)
+                self._watchdog.stop()
+                self._watchdog = None
+            saved = self._preemption_checkpoint()
+            if self.telemetry is not None:
+                self.telemetry.instant("preempt.exit", epoch=self.epoch,
+                                       iteration=self.iteration,
+                                       checkpointed=saved)
+            self.recorder.save()
+            model.cleanup()
+            raise PreemptionExit(
+                f"preempted at epoch {self.epoch}, iteration "
+                f"{self.iteration}"
+                + ("; resumable checkpoint saved" if saved else ""))
+        finally:
+            self._preempt_guard = None
+            if guard is not None:
+                guard.uninstall()
+            if self._watchdog is not None:
+                self._watchdog.stop()
+                self._watchdog = None
+            self._heartbeat = None
             # window ran past the end of training, OR an exception landed
             # inside it — either way the device trace must be stopped and
             # flushed, not leaked (the bounded-window contract)
@@ -837,8 +1094,6 @@ class BaseTrainer:
             # same correlated-failure discipline Rule.wait applies to
             # telemetry finalize)
             if self.checkpointer is not None:
-                import sys
-
                 if sys.exc_info()[0] is None:
                     self.checkpointer.join_pending()
                 else:
@@ -887,6 +1142,10 @@ class Rule:
             profile_dir=self.config.get("profile_dir"),
             profile_window=tuple(self.config.get("profile_window", (10, 20))),
             telemetry=self.make_telemetry(),
+            # ISSUE 4: fault_plan / sentinel_* / watchdog* / heartbeat_path /
+            # handle_preemption / prefetch_stall_timeout rule keys (see
+            # ResilienceConfig.KEYS); defaults are all-off
+            resilience=ResilienceConfig.from_rule_config(self.config),
         )
 
     def make_telemetry(self):

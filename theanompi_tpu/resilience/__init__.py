@@ -1,0 +1,145 @@
+"""theanompi_tpu.resilience — supervised training, fault injection,
+health watchdog, non-finite sentinel, preemption handling (ISSUE 4).
+
+The reference Theano-MPI stack had no fault story: one dead rank killed
+the whole ``mpirun`` tree (SURVEY.md §4).  This package is the TPU
+rebuild's robustness layer — the framework itself survives crashes,
+preemptions, NaNs and hangs:
+
+- :mod:`supervisor` — child-process auto-restart loop with exit
+  classification, bounded exponential backoff + jitter, checkpoint
+  auto-resume, and a ``resilience.json`` audit trail
+  (``tmlauncher --supervise``);
+- :mod:`faults` — the deterministic fault plan
+  (``THEANOMPI_FAULT_PLAN`` / ``fault_plan`` rule key) that makes every
+  recovery path exercisable in CPU tier-1 tests;
+- :mod:`watchdog` — heartbeat file + median-adaptive stall detector;
+- :mod:`sentinel` — non-finite loss/grad guard (abort / skip_batch /
+  rollback) and cooperative SIGTERM preemption handling.
+
+Everything is **off by default**: a run without ``--supervise``, without
+resilience rule keys and without the env vars makes no behavioral change
+to any existing entry path (locked by tests).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from theanompi_tpu.resilience.supervisor import (  # noqa: F401
+    EXIT_CLEAN,
+    EXIT_CONFIG,
+    EXIT_CRASH,
+    EXIT_HANG,
+    EXIT_PREEMPTED,
+    Supervisor,
+    classify_exit,
+)
+from theanompi_tpu.resilience.faults import (  # noqa: F401
+    FaultInjected,
+    FaultPlan,
+    FaultPlanError,
+)
+from theanompi_tpu.resilience.sentinel import (  # noqa: F401
+    NonFiniteLossError,
+    PreemptGuard,
+    PreemptionExit,
+    PreemptionRequested,
+    Sentinel,
+    SentinelRollback,
+)
+from theanompi_tpu.resilience.watchdog import (  # noqa: F401
+    Heartbeat,
+    Watchdog,
+    heartbeat_age_s,
+)
+
+
+def supervised() -> bool:
+    """Whether this process runs under a :class:`Supervisor`."""
+    return os.environ.get("THEANOMPI_SUPERVISED") == "1"
+
+
+@dataclass
+class ResilienceConfig:
+    """Per-trainer resilience knobs, resolved from the rule config + env.
+
+    Every field's default means OFF (or supervisor-auto): a default
+    instance created for a bare trainer changes nothing unless the
+    supervisor env vars (``THEANOMPI_SUPERVISED`` / ``THEANOMPI_HEARTBEAT``
+    / ``THEANOMPI_FAULT_PLAN``) are present.
+    """
+
+    fault_plan: str | None = None            # faults.FaultPlan grammar
+    sentinel_policy: str | None = None       # None=off | abort|skip_batch|rollback
+    sentinel_max_skips: int = 8
+    sentinel_max_rollbacks: int = 2
+    watchdog: bool | None = None             # None=auto: on iff heartbeat set
+    watchdog_multiple: float = 10.0
+    watchdog_min_s: float = 30.0
+    watchdog_poll_s: float = 1.0
+    heartbeat_path: str | None = None        # None: THEANOMPI_HEARTBEAT env
+    handle_preemption: bool | None = None    # None=auto: on iff supervised
+    prefetch_stall_timeout: float | None = None
+
+    #: rule-config keys consumed by :meth:`from_rule_config`
+    KEYS = ("fault_plan", "sentinel_policy", "sentinel_max_skips",
+            "sentinel_max_rollbacks", "watchdog", "watchdog_multiple",
+            "watchdog_min_s", "watchdog_poll_s", "heartbeat_path",
+            "handle_preemption", "prefetch_stall_timeout")
+
+    @classmethod
+    def from_rule_config(cls, config: dict) -> "ResilienceConfig":
+        return cls(**{k: config[k] for k in cls.KEYS if k in config})
+
+    # -- resolution (config beats env; env is the supervisor's channel) ------
+    def resolved_heartbeat_path(self) -> str | None:
+        return self.heartbeat_path or os.environ.get("THEANOMPI_HEARTBEAT")
+
+    def watchdog_enabled(self) -> bool:
+        if self.watchdog is not None:
+            return bool(self.watchdog)
+        return self.resolved_heartbeat_path() is not None
+
+    def preemption_enabled(self) -> bool:
+        if self.handle_preemption is not None:
+            return bool(self.handle_preemption)
+        return supervised()
+
+    # -- builders (lazy: a disabled feature imports/allocates nothing) -------
+    def build_fault_plan(self) -> FaultPlan | None:
+        return FaultPlan.from_spec(self.fault_plan)
+
+    def build_sentinel(self, telemetry=None) -> Sentinel | None:
+        if self.sentinel_policy is None:
+            return None
+        return Sentinel(policy=self.sentinel_policy,
+                        max_skips=int(self.sentinel_max_skips),
+                        max_rollbacks=int(self.sentinel_max_rollbacks),
+                        telemetry=telemetry)
+
+    def build_heartbeat(self) -> Heartbeat | None:
+        """The liveness file writer alone — for when the in-process stall
+        DETECTOR is disabled (``watchdog=False``) but a supervisor still
+        watches the heartbeat file (``--hang-timeout`` backstop): turning
+        off the detector must not silence liveness reporting, or the
+        backstop would kill every healthy child at the timeout."""
+        path = self.resolved_heartbeat_path()
+        return Heartbeat(path) if path else None
+
+    def build_watchdog(self, telemetry=None) -> Watchdog | None:
+        if not self.watchdog_enabled():
+            return None
+        hb_path = self.resolved_heartbeat_path()
+        heartbeat = Heartbeat(hb_path) if hb_path else None
+        return Watchdog(
+            multiple=float(self.watchdog_multiple),
+            min_timeout_s=float(self.watchdog_min_s),
+            poll_s=float(self.watchdog_poll_s),
+            heartbeat=heartbeat,
+            telemetry=telemetry,
+            # an unsupervised user's run is warned, never self-killed
+            escalate="exit" if supervised() else "warn",
+            exit_code=EXIT_HANG,
+        )

@@ -1,0 +1,14 @@
+"""The exit-code contract, in one place (sysexits.h-adjacent).
+
+Both halves of the resilience layer need these — the sentinel raises
+``PreemptionExit(EXIT_PREEMPTED)`` inside the training process, the
+supervisor classifies child exit codes outside it — and a drifted
+duplicate would silently turn preemptions into budget-burning crashes,
+so the constants live in this leaf module with no other imports.
+"""
+
+EXIT_CLEAN = 0
+EXIT_CRASH = 70      # EX_SOFTWARE: unhandled training exception
+EXIT_PREEMPTED = 75  # EX_TEMPFAIL: clean resumable preemption exit
+EXIT_HANG = 76       # EX_PROTOCOL (repurposed): watchdog-confirmed stall
+EXIT_CONFIG = 78     # EX_CONFIG: bad flags/config/model import

@@ -1,0 +1,154 @@
+"""Deterministic, CPU-testable fault injection (ISSUE 4).
+
+The reference stack had no fault story at all — a dead rank killed the
+whole ``mpirun`` tree (SURVEY.md §4) and nothing could *rehearse* a crash.
+Here every recovery path the resilience layer promises (supervisor
+restart, sentinel policies, prefetch stall detection, checkpoint-writer
+failure) is exercisable in tier-1 CPU tests through one deterministic
+fault plan.
+
+Grammar (``THEANOMPI_FAULT_PLAN`` env var or the ``fault_plan`` rule key;
+specs separated by ``;`` or ``,``)::
+
+    SITE:ACTION@INDEX[@ATTEMPT]
+
+    step:raise@12        raise FaultInjected when train_iter reaches step 12
+    step:kill@12@1       SIGKILL the process at step 12, attempt 1 only
+    step:nan@12          poison step 12's batch with NaN (a real NaN loss,
+                         so the sentinel's device guard sees the genuine
+                         article, not a spoofed metric)
+    prefetch:stall@3     the Prefetcher's source hangs before batch 3
+                         (exercises stall_timeout / PrefetchStallError)
+    prefetch:raise@3     the source iterator raises at batch 3
+    checkpoint:fail@1    Checkpointer._write raises OSError for epoch 1
+
+``INDEX`` is the global step for ``step``, the batch ordinal for
+``prefetch``, and the epoch for ``checkpoint``.  The optional ``ATTEMPT``
+gates a spec to one supervisor attempt (``THEANOMPI_ATTEMPT``, which the
+supervisor sets; unsupervised processes count as attempt 1) — a ``kill``
+spec under supervision should carry ``@1`` so the restarted attempt does
+not re-die at the same step.  Each spec fires at most once per process.
+
+Zero cost when absent: with no plan configured every injection point is a
+single ``is None`` check.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+from dataclasses import dataclass, field
+
+
+class FaultInjected(RuntimeError):
+    """An injected failure (never raised unless a fault plan asked for it)."""
+
+
+class FaultPlanError(ValueError):
+    """A fault-plan string that does not parse."""
+
+
+#: valid actions per injection site
+SITES = {
+    "step": ("raise", "kill", "nan"),
+    "prefetch": ("stall", "raise"),
+    "checkpoint": ("fail",),
+}
+
+
+def current_attempt() -> int:
+    """The supervisor attempt this process is (1 when unsupervised)."""
+    try:
+        return int(os.environ.get("THEANOMPI_ATTEMPT", "1"))
+    except ValueError:
+        return 1
+
+
+@dataclass
+class FaultSpec:
+    site: str
+    action: str
+    index: int
+    attempt: int | None = None
+    fired: bool = field(default=False, compare=False)
+
+    def matches(self, site: str, index: int) -> bool:
+        return (
+            not self.fired
+            and self.site == site
+            and self.index == int(index)
+            and (self.attempt is None or self.attempt == current_attempt())
+        )
+
+
+class FaultPlan:
+    """An ordered list of one-shot :class:`FaultSpec` entries."""
+
+    def __init__(self, specs: list[FaultSpec]):
+        self.specs = specs
+
+    def __repr__(self) -> str:
+        return f"FaultPlan({self.specs!r})"
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultPlan":
+        specs = []
+        for raw in text.replace(";", ",").split(","):
+            raw = raw.strip()
+            if not raw:
+                continue
+            head, _, rest = raw.partition("@")
+            site, _, action = head.partition(":")
+            site, action = site.strip(), action.strip()
+            if site not in SITES:
+                raise FaultPlanError(
+                    f"unknown fault site {site!r} in {raw!r} "
+                    f"(sites: {', '.join(SITES)})"
+                )
+            if action not in SITES[site]:
+                raise FaultPlanError(
+                    f"action {action!r} invalid for site {site!r} in {raw!r} "
+                    f"(valid: {', '.join(SITES[site])})"
+                )
+            if not rest:
+                raise FaultPlanError(f"missing @INDEX in fault spec {raw!r}")
+            parts = rest.split("@")
+            if len(parts) > 2:
+                raise FaultPlanError(f"too many '@' in fault spec {raw!r}")
+            try:
+                index = int(parts[0])
+                attempt = int(parts[1]) if len(parts) == 2 else None
+            except ValueError as e:
+                raise FaultPlanError(
+                    f"non-integer index/attempt in fault spec {raw!r}"
+                ) from e
+            specs.append(FaultSpec(site, action, index, attempt))
+        if not specs:
+            raise FaultPlanError(f"empty fault plan {text!r}")
+        return cls(specs)
+
+    @classmethod
+    def from_spec(cls, spec: "str | FaultPlan | None") -> "FaultPlan | None":
+        """Build from an explicit spec string, falling back to the
+        ``THEANOMPI_FAULT_PLAN`` env var; None when neither is set."""
+        if isinstance(spec, FaultPlan):
+            return spec
+        text = spec or os.environ.get("THEANOMPI_FAULT_PLAN")
+        return cls.parse(text) if text else None
+
+    def fire(self, site: str, index: int) -> str | None:
+        """The action to inject at (site, index) now, or None.  Marks the
+        matched spec fired so it cannot trigger twice in one process."""
+        for s in self.specs:
+            if s.matches(site, index):
+                s.fired = True
+                return s.action
+        return None
+
+
+def kill_self() -> None:
+    """SIGKILL this process — the un-handleable death a preempted VM or an
+    OOM-killer delivers; nothing downstream of this line runs."""
+    print("faults: injected SIGKILL", file=sys.stderr, flush=True)
+    os.kill(os.getpid(), signal.SIGKILL)

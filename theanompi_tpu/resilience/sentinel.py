@@ -1,0 +1,206 @@
+"""Non-finite guard + preemption handling (ISSUE 4).
+
+**Sentinel.**  A NaN/Inf loss is the classic silent killer: by the time a
+human notices, every parameter is NaN and the checkpoints have rotated.
+The sentinel watches the train-loss stream (and, for ``skip_batch``, a
+device-side gradient-norm guard compiled into the step) and applies one
+of three policies:
+
+- ``abort`` (the default when enabled): raise :class:`NonFiniteLossError`
+  — under supervision that is a crash the supervisor restarts from the
+  last checkpoint.
+- ``skip_batch``: an on-device guard (see ``make_local_step``) computes
+  ``ok = isfinite(loss) & isfinite(grad_norm²)`` — reduced across workers
+  so replicas stay in lockstep — and selects the *old* params/state/opt
+  state when the step was poisoned, so one bad batch costs one skipped
+  update instead of the run.  The skip count is bounded
+  (``sentinel_max_skips``); exhausting it raises.
+- ``rollback``: reload the latest checkpoint **in-process** (bounded by
+  ``sentinel_max_rollbacks``) and replay from there — for the transient
+  blow-up an LR schedule or bad shard causes once.
+
+Detection honesty: the host-side check only *materializes* loss scalars
+at the recorder's fenced print boundaries (per-step blocking would
+serialize the dispatch pipeline — the same discipline the recorder and
+telemetry spans follow), so abort/rollback trigger up to ``print_freq-1``
+steps after the first bad loss.  The ``skip_batch`` device guard has zero
+detection latency — the selection happens inside the compiled step.
+
+**Preemption.**  :class:`PreemptGuard` turns SIGTERM (what a TPU-VM
+maintenance event or spot reclaim sends) into a cooperative flag the run
+loop checks between steps; the trainer then writes a final synchronous
+checkpoint and raises :class:`PreemptionExit` — a ``SystemExit`` carrying
+the distinct ``EXIT_PREEMPTED`` code the supervisor treats as
+*resume-don't-count-against-the-restart-budget*.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+
+import numpy as np
+
+from theanompi_tpu.resilience.codes import EXIT_PREEMPTED
+
+POLICIES = ("abort", "skip_batch", "rollback")
+
+
+class NonFiniteLossError(RuntimeError):
+    """Training produced a non-finite loss/grad-norm the policy could not
+    absorb."""
+
+    def __init__(self, message: str, step: int | None = None):
+        super().__init__(message)
+        self.step = step
+
+
+class SentinelRollback(Exception):
+    """Internal control flow: run() catches this and reloads the latest
+    checkpoint (never escapes the trainer)."""
+
+    def __init__(self, step: int):
+        super().__init__(f"non-finite loss at step {step}")
+        self.step = step
+
+
+class PreemptionRequested(Exception):
+    """Internal control flow: a preemption signal arrived; run() unwinds
+    to its handler (never escapes the trainer)."""
+
+
+class PreemptionExit(SystemExit):
+    """Clean resumable exit after a preemption checkpoint.  A SystemExit
+    subclass so an unhandled escape still exits the process with
+    ``EXIT_PREEMPTED`` instead of a traceback."""
+
+    def __init__(self, message: str):
+        super().__init__(EXIT_PREEMPTED)
+        self.message = message
+
+    def __str__(self) -> str:
+        return self.message
+
+
+class Sentinel:
+    """Host-side half of the non-finite guard (policy + bounded budgets).
+
+    ``watch()`` is called once per train step with *lazy references* to
+    the step's loss (and, under ``skip_batch``, the device guard's skip
+    flag); ``check()`` materializes everything pending — callers invoke it
+    at fenced boundaries where the values are already computed, so it
+    costs device→host scalar pulls, never a sync.
+    """
+
+    def __init__(self, policy: str = "abort", max_skips: int = 8,
+                 max_rollbacks: int = 2, telemetry=None):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"sentinel policy must be one of {POLICIES}, got {policy!r}")
+        self.policy = policy
+        self.max_skips = max_skips
+        self.max_rollbacks = max_rollbacks
+        self.telemetry = telemetry
+        self.skips = 0.0          # cumulative skipped updates (skip_batch)
+        self.rollbacks = 0        # maintained by the trainer
+        self._pending: list[tuple[int, object, object]] = []
+
+    @property
+    def device_guard(self) -> bool:
+        """Whether the compiled step must carry the finite-select guard."""
+        return self.policy == "skip_batch"
+
+    def watch(self, step: int, cost, skip_flag=None) -> None:
+        self._pending.append((step, cost, skip_flag))
+
+    def reset_pending(self) -> None:
+        """Drop unmaterialized observations (after a rollback restored an
+        older state, pending losses describe a discarded timeline)."""
+        self._pending.clear()
+
+    def check(self) -> None:
+        """Materialize pending observations and enforce the policy.
+
+        Raises :class:`NonFiniteLossError` (abort / budget exhausted) or
+        :class:`SentinelRollback` (rollback policy).
+        """
+        pending, self._pending = self._pending, []
+        for step, cost, skip_flag in pending:
+            if skip_flag is not None:
+                # device guard already protected the params; enforce budget
+                n = float(np.max(np.asarray(skip_flag)))
+                if n > 0:
+                    self.skips += n
+                    self._emit("sentinel.skip", step=step,
+                               total_skips=self.skips)
+                    print(f"sentinel: skipped non-finite update at step "
+                          f"{step} ({self.skips:g}/{self.max_skips} budget)",
+                          file=sys.stderr, flush=True)
+                    if self.skips > self.max_skips:
+                        raise NonFiniteLossError(
+                            f"sentinel skip budget exhausted: "
+                            f"{self.skips:g} skipped updates > "
+                            f"max_skips={self.max_skips}", step=step)
+                continue
+            if cost is None:
+                continue
+            if bool(np.isfinite(np.asarray(cost)).all()):
+                continue
+            self._emit("sentinel.nonfinite", step=step, policy=self.policy)
+            if self.policy == "rollback":
+                raise SentinelRollback(step)
+            raise NonFiniteLossError(
+                f"non-finite loss at step {step} (sentinel policy 'abort'; "
+                f"use sentinel_policy=skip_batch/rollback to absorb "
+                f"transients)", step=step)
+
+    def _emit(self, name: str, **fields) -> None:
+        if self.telemetry is not None:
+            self.telemetry.instant(name, **fields)
+
+
+class PreemptGuard:
+    """Cooperative preemption-signal handler (main thread only).
+
+    The handler itself only flips a flag and writes one stderr line —
+    everything heavier (the final checkpoint, the resumable exit) happens
+    in the run loop at a step boundary, where the training state is
+    consistent.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,), telemetry=None):
+        self.signals = tuple(signals)
+        self.telemetry = telemetry
+        self.triggered = False
+        self._prev: dict[int, object] = {}
+        self.installed = False
+
+    def _handler(self, signum, frame) -> None:
+        self.triggered = True
+        # signal-safe-ish: one small write, no allocation-heavy work
+        try:
+            name = signal.Signals(signum).name
+        except ValueError:
+            name = str(signum)
+        sys.stderr.write(
+            f"preempt: received {name}; will checkpoint and exit at the "
+            f"next step boundary\n")
+
+    def install(self) -> bool:
+        """Install handlers; -> False (inactive) off the main thread,
+        where ``signal.signal`` is illegal."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+        for sig in self.signals:
+            self._prev[sig] = signal.signal(sig, self._handler)
+        self.installed = True
+        return True
+
+    def uninstall(self) -> None:
+        if not self.installed:
+            return
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        self._prev.clear()
+        self.installed = False

@@ -1,0 +1,339 @@
+"""Supervised training: auto-restart with backoff + resume (ISSUE 4).
+
+The t5x/orbax-style auto-resume loop, built into the framework instead of
+bolted onto each driver: the supervisor runs the training session (the
+launcher's ``rule.init(...).wait()``) in a **child process**, classifies
+how it died, and restarts it — auto-resuming from the latest checkpoint —
+under a bounded exponential-backoff budget.  A child process, not a
+thread or a try/except: SIGKILL, OOM, a wedged XLA runtime and a
+preempting hypervisor all kill *processes*, and only a fresh process can
+re-initialize a jax backend cleanly (the same lesson ``bench.py``'s
+re-exec retry learned in round 4).
+
+Exit-code contract (see the package ``__init__`` / README table)::
+
+    0              clean        -> done
+    75 / -SIGTERM  preemption   -> restart; does NOT count against budget
+    76             hang         -> restart (counts)
+    2 / 78         config       -> fatal, no restart (it won't fix itself)
+    anything else  crash        -> restart (counts)
+
+Every attempt is recorded — cause, exit code, duration, time lost — to a
+crash-safe ``resilience.json`` summary, and mirrored as JSONL events into
+the telemetry directory (``supervisor.jsonl``; a separate file because
+each child attempt truncates and rewrites the per-rank event sinks).
+
+Hang detection is layered: the child's in-process :class:`~theanompi_tpu.
+resilience.watchdog.Watchdog` (median-adaptive, exits ``EXIT_HANG``
+itself) is primary; the supervisor's ``hang_timeout_s`` is the blunt
+mtime-based backstop for a child too wedged to run even its watchdog
+thread, enabled only when configured (``--hang-timeout``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from theanompi_tpu.resilience.codes import (
+    EXIT_CLEAN,
+    EXIT_CONFIG,
+    EXIT_CRASH,
+    EXIT_HANG,
+    EXIT_PREEMPTED,
+)
+from theanompi_tpu.resilience.watchdog import heartbeat_age_s
+
+#: restart-budget-exempt preemptions still need SOME bound, or a
+#: preempt-loop (bad zone) supervises forever
+MAX_PREEMPTIONS = 64
+
+
+def classify_exit(returncode: int) -> str:
+    """-> 'clean' | 'preemption' | 'hang' | 'config' | 'crash'."""
+    if returncode == EXIT_CLEAN:
+        return "clean"
+    # -SIGTERM: the preemptor's signal landed before (or instead of) the
+    # child's cooperative handler — still a preemption, but the child had
+    # no chance to checkpoint, so resume falls back to the last epoch
+    if returncode in (EXIT_PREEMPTED, -signal.SIGTERM):
+        return "preemption"
+    if returncode == EXIT_HANG:
+        return "hang"
+    # 2 is argparse's usage-error exit
+    if returncode in (EXIT_CONFIG, 2):
+        return "config"
+    return "crash"
+
+
+class Supervisor:
+    """Run a child command under restart supervision.
+
+    ``child_cmd`` is the full argv of one training attempt;
+    ``resume_args`` (default ``("--resume",)``) are appended from the
+    second attempt on, so restarts pick up the latest checkpoint while the
+    first attempt honors exactly what the user asked for.
+    """
+
+    def __init__(self, child_cmd: list[str], *, max_restarts: int = 3,
+                 backoff_base: float = 1.0, backoff_cap: float = 60.0,
+                 jitter: float = 0.5, hang_timeout_s: float | None = None,
+                 poll_s: float = 0.2, heartbeat_path: str | None = None,
+                 resilience_path: str = "resilience.json",
+                 telemetry_dir: str | None = None,
+                 resume_args: tuple[str, ...] = ("--resume",),
+                 env: dict | None = None, seed: int = 0,
+                 sleep=None):
+        self.child_cmd = list(child_cmd)
+        self.max_restarts = max_restarts
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self.jitter = jitter
+        if hang_timeout_s is not None and hang_timeout_s < 3.0:
+            # the child's heartbeat writer rate-limits to ~1/s: a timeout
+            # at or below that kills every healthy child as "hung"
+            self._log(f"hang_timeout_s={hang_timeout_s:g} is below the "
+                      f"heartbeat write interval; clamping to 3.0s")
+            hang_timeout_s = 3.0
+        self.hang_timeout_s = hang_timeout_s
+        self.poll_s = poll_s
+        self.heartbeat_path = heartbeat_path
+        self.resilience_path = resilience_path
+        self.telemetry_dir = telemetry_dir
+        self.resume_args = tuple(resume_args)
+        self.env = dict(env or {})
+        self.sleep = sleep
+        self._rng = random.Random(seed)  # jittered backoff, reproducible
+        self.attempts: list[dict] = []
+        self._proc: subprocess.Popen | None = None
+        self._terminated = False
+        # default backoff sleeper: an event wait, so a SIGTERM landing
+        # DURING the backoff interrupts it instead of sleeping through the
+        # preemption grace period (tests inject `sleep` to fake delays)
+        self._term_event = threading.Event()
+
+    # -- one attempt ---------------------------------------------------------
+    def _attempt_cmd(self, attempt: int) -> list[str]:
+        cmd = list(self.child_cmd)
+        if attempt > 1:
+            cmd += [a for a in self.resume_args if a not in cmd]
+        return cmd
+
+    def _attempt_env(self, attempt: int) -> dict:
+        env = dict(os.environ)
+        env.update(self.env)
+        env["THEANOMPI_SUPERVISED"] = "1"
+        env["THEANOMPI_ATTEMPT"] = str(attempt)
+        if self.heartbeat_path:
+            env["THEANOMPI_HEARTBEAT"] = self.heartbeat_path
+        return env
+
+    def _wait(self, proc: subprocess.Popen,
+              started_s: float) -> tuple[int, bool]:
+        """Poll the child; -> (returncode, killed_as_hung)."""
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                return rc, False
+            # real sleep, NOT the injected self.sleep: that seam fakes the
+            # restart BACKOFF in tests; a faked poll would busy-spin here
+            if self.hang_timeout_s is not None and self.heartbeat_path:
+                age = heartbeat_age_s(self.heartbeat_path)
+                if age is None:
+                    # no heartbeat yet: measure from attempt start (compile
+                    # time counts — size the timeout accordingly)
+                    age = time.perf_counter() - started_s
+                if age > self.hang_timeout_s:
+                    self._log(f"no heartbeat for {age:.0f}s "
+                              f"(> {self.hang_timeout_s:.0f}s); killing "
+                              f"hung child pid {proc.pid}")
+                    proc.kill()
+                    return proc.wait(), True
+            time.sleep(self.poll_s)
+
+    def _backoff_s(self, restarts: int) -> float:
+        base = min(self.backoff_cap,
+                   self.backoff_base * (2 ** max(0, restarts - 1)))
+        return base * (1.0 + self.jitter * self._rng.random())
+
+    def _forward_term(self, signum, frame) -> None:
+        """A preempted VM TERMs the supervisor too: hand the signal to the
+        child (whose preemption handler checkpoints and exits 75) and end
+        supervision after it — never restart into a dying machine."""
+        self._terminated = True
+        self._term_event.set()  # wake a supervisor mid-backoff
+        p = self._proc
+        if p is not None and p.poll() is None:
+            try:
+                p.terminate()
+            except OSError:  # lint: swallow-ok — child already gone
+                pass
+
+    # -- the loop ------------------------------------------------------------
+    def run(self) -> int:
+        prev_term = None
+        if threading.current_thread() is threading.main_thread():
+            prev_term = signal.signal(signal.SIGTERM, self._forward_term)
+        try:
+            return self._run()
+        finally:
+            if prev_term is not None:
+                signal.signal(signal.SIGTERM, prev_term)
+            # abnormal exit (KeyboardInterrupt in the poll loop, a bug)
+            # must not orphan a still-running training child — on every
+            # normal path _proc is already None here
+            p, self._proc = self._proc, None
+            if p is not None and p.poll() is None:
+                self._log(f"terminating child pid {p.pid} on abnormal "
+                          f"supervisor exit")
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+
+    def _run(self) -> int:
+        t_run0 = time.perf_counter()
+        attempt, restarts, preemptions = 0, 0, 0
+        final = EXIT_CRASH
+        while True:
+            if self._terminated:
+                # SIGTERM landed between attempts (during backoff): never
+                # spawn a fresh child into a dying machine
+                self._log("terminated during backoff; not restarting")
+                final = EXIT_PREEMPTED
+                break
+            attempt += 1
+            if self.heartbeat_path:
+                try:
+                    os.remove(self.heartbeat_path)  # stale mtime = insta-kill
+                except OSError:
+                    pass  # lint: swallow-ok
+            cmd = self._attempt_cmd(attempt)
+            self._log(f"attempt {attempt}: {' '.join(cmd)}")
+            t0 = time.perf_counter()
+            proc = subprocess.Popen(cmd, env=self._attempt_env(attempt))
+            self._proc = proc
+            rc, hung = self._wait(proc, t0)
+            # cleared only on the NORMAL path: an exception out of _wait
+            # leaves _proc set so run()'s finally can terminate the child
+            self._proc = None
+            dur = time.perf_counter() - t0
+            cause = "hang" if hung else classify_exit(rc)
+            rec = {"attempt": attempt, "cause": cause, "exit_code": rc,
+                   "duration_s": round(dur, 3)}
+            if cause not in ("clean", "preemption"):
+                # progress since the last published checkpoint is gone; the
+                # attempt's whole duration is the honest upper bound
+                rec["time_lost_s"] = round(dur, 3)
+            self.attempts.append(rec)
+            self._emit({"name": "supervisor.attempt", **rec})
+            if cause == "clean":
+                final = EXIT_CLEAN
+                break
+            if self._terminated:
+                self._log("terminated; ending supervision after the "
+                          "child's shutdown (no restart)")
+                final = rc if rc > 0 else EXIT_PREEMPTED
+                break
+            if cause == "config":
+                if attempt == 1:
+                    self._log(f"attempt 1 exited with a config error "
+                              f"(exit {rc}); not restarting")
+                    final = rc
+                    break
+                # a config classification appearing only on a RESTART is
+                # suspect: attempt 1 got past init, so this is more likely
+                # environmental fallout of the previous death (e.g. an
+                # accelerator lock released lazily after a SIGKILL,
+                # shrinking the visible device count) — burn budget and
+                # retry rather than give up with restarts remaining
+                self._log(f"attempt {attempt} exited with a config error "
+                          f"(exit {rc}) AFTER a working first attempt; "
+                          f"treating as a restartable crash")
+                cause = "crash"
+                self.attempts[-1]["cause"] = "crash(config-on-restart)"
+            if cause == "preemption":
+                preemptions += 1
+                if preemptions > MAX_PREEMPTIONS:
+                    self._log(f"{preemptions} preemptions; giving up")
+                    final = rc if rc > 0 else EXIT_PREEMPTED
+                    break
+            else:
+                restarts += 1
+                if restarts > self.max_restarts:
+                    self._log(f"restart budget exhausted "
+                              f"({restarts - 1}/{self.max_restarts}); "
+                              f"giving up after {cause} (exit {rc})")
+                    final = rc if rc > 0 else EXIT_CRASH
+                    break
+            delay = self._backoff_s(max(1, restarts))
+            budget = ("free" if cause == "preemption"
+                      else f"{restarts}/{self.max_restarts}")
+            self._log(f"attempt {attempt} ended: {cause} (exit {rc}); "
+                      f"restart {budget} with resume in {delay:.1f}s")
+            self._write_summary(final=None, t_run0=t_run0,
+                                restarts=restarts, preemptions=preemptions)
+            if self.sleep is not None:
+                self.sleep(delay)
+            else:
+                self._term_event.wait(delay)  # interruptible by SIGTERM
+        self._write_summary(final=final, t_run0=t_run0,
+                            restarts=restarts, preemptions=preemptions)
+        self._emit({"name": "supervisor.done", "final_exit": final,
+                    "restarts": restarts, "preemptions": preemptions})
+        return final
+
+    # -- reporting -----------------------------------------------------------
+    def summary(self, final, t_run0, restarts, preemptions) -> dict:
+        return {
+            "attempts": self.attempts,
+            "restarts": restarts,
+            "preemptions": preemptions,
+            "time_lost_s": round(sum(a.get("time_lost_s", 0.0)
+                                     for a in self.attempts), 3),
+            "total_s": round(time.perf_counter() - t_run0, 3),
+            "final_exit": final,  # None while still running
+        }
+
+    def _write_summary(self, **kw) -> None:
+        """Crash-safe rewrite after every attempt, not just at the end —
+        a supervisor killed mid-run still leaves the attempt record."""
+        path = self.resilience_path
+        try:
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            with open(path + ".tmp", "w") as f:
+                json.dump(self.summary(**kw), f, indent=1)
+            os.replace(path + ".tmp", path)
+        except OSError as e:
+            self._log(f"could not write {path}: {e}")
+
+    def _emit(self, event: dict) -> None:
+        """Mirror supervisor events into the telemetry dir as JSONL.
+
+        A dedicated ``supervisor.jsonl`` (append mode), NOT an
+        ``events-rank*`` sink: each child attempt truncates those, and the
+        aggregation pass must not mistake the supervisor for a rank."""
+        if not self.telemetry_dir:
+            return
+        try:
+            os.makedirs(self.telemetry_dir, exist_ok=True)
+            line = json.dumps({"ts": time.time(),  # lint: wall-ok
+                               "kind": "instant", **event})
+            with open(os.path.join(self.telemetry_dir,
+                                   "supervisor.jsonl"), "a") as f:
+                f.write(line + "\n")
+        except OSError as e:
+            self._log(f"could not write supervisor telemetry: {e}")
+
+    @staticmethod
+    def _log(msg: str) -> None:
+        print(f"supervisor: {msg}", file=sys.stderr, flush=True)

@@ -1,0 +1,200 @@
+"""Heartbeat + stall detection (ISSUE 4).
+
+Two cooperating halves:
+
+- **In-process** (:class:`Watchdog`): ``train_iter`` feeds :meth:`beat`
+  after every step; a daemon thread compares time-since-last-beat against
+  a configurable multiple of the *trailing median* step time (with an
+  absolute floor, so a slow-but-steady model is never flagged).  On
+  trigger it emits a ``watchdog.stall`` telemetry event and — under
+  supervision — exits the process with :data:`~theanompi_tpu.resilience.
+  EXIT_HANG` so the supervisor classifies the death as a hang and
+  restarts from the latest checkpoint.  Adaptive by construction: no
+  threshold to tune per model, and no trigger until at least three step
+  durations exist (the first step's XLA compile never trips it).
+- **Cross-process** (the heartbeat file): every beat also refreshes an
+  atomic JSON heartbeat file (step counter + wall timestamp,
+  rate-limited), which the supervisor watches by mtime as a backstop for
+  the case the in-process watchdog cannot catch — a process wedged so
+  hard (stuck in a C call holding the GIL, SIGSTOP'd, swapping) that even
+  the watchdog thread stops running.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+from collections import deque
+
+from theanompi_tpu.resilience.codes import EXIT_HANG
+
+
+class Heartbeat:
+    """Atomic, rate-limited progress file: ``{"step": N, "time": wall}``."""
+
+    def __init__(self, path: str, min_interval_s: float = 1.0):
+        self.path = path
+        self.min_interval_s = min_interval_s
+        self._last_write = -float("inf")
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+
+    def beat(self, step: int, force: bool = False) -> None:
+        now = time.perf_counter()
+        if not force and now - self._last_write < self.min_interval_s:
+            return
+        self._last_write = now
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                # wall time: the supervisor compares against ITS clock via
+                # the file mtime, and the payload is for humans
+                json.dump({"step": int(step), "pid": os.getpid(),
+                           "time": time.time()}, f)  # lint: wall-ok
+            os.replace(tmp, self.path)  # a reader never sees a torn write
+        except OSError:
+            # a full disk must degrade the heartbeat, not kill training;
+            # the supervisor's mtime backstop goes stale, which is the
+            # honest signal for "this host can no longer prove liveness"
+            pass  # lint: swallow-ok
+
+
+def heartbeat_age_s(path: str) -> float | None:
+    """Seconds since the heartbeat file last changed (supervisor side);
+    None when the file does not exist yet."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return max(0.0, time.time() - st.st_mtime)  # lint: wall-ok
+
+
+class Watchdog:
+    """Median-adaptive stall detector fed by ``train_iter``.
+
+    ``escalate='exit'`` (the supervised default) hard-exits with
+    ``exit_code`` on a confirmed stall; ``'warn'`` (the unsupervised
+    default) prints one line and keeps going — an unsupervised user's run
+    must never be killed by its own safety net.
+    """
+
+    def __init__(self, multiple: float = 10.0, min_timeout_s: float = 30.0,
+                 poll_s: float = 1.0, window: int = 64,
+                 heartbeat: Heartbeat | None = None, telemetry=None,
+                 escalate: str = "warn", exit_code: int = EXIT_HANG,
+                 _exit=os._exit, _clock=time.perf_counter):
+        if escalate not in ("exit", "warn"):
+            raise ValueError(f"escalate must be 'exit' or 'warn', "
+                             f"got {escalate!r}")
+        self.multiple = multiple
+        self.min_timeout_s = min_timeout_s
+        self.poll_s = poll_s
+        self.heartbeat = heartbeat
+        self.telemetry = telemetry
+        self.escalate = escalate
+        self.exit_code = exit_code
+        self._exit = _exit
+        self._clock = _clock
+        self._durs: deque[float] = deque(maxlen=window)
+        self._lock = threading.Lock()
+        self._last_beat: float | None = None
+        self._step = -1
+        self._paused = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.triggered = False
+
+    # -- producer side (training thread) -------------------------------------
+    def beat(self, step: int) -> None:
+        now = self._clock()
+        with self._lock:
+            if self._last_beat is not None:
+                self._durs.append(now - self._last_beat)
+            self._last_beat = now
+            self._step = int(step)
+        if self.heartbeat is not None:
+            self.heartbeat.beat(step)
+
+    def pause(self) -> None:
+        """Suspend stall detection across legitimate beat-free stretches —
+        epoch-boundary work (validation's first eval compile, the val
+        sweep, checkpoint joins) takes arbitrarily long without a single
+        train step, and must not read as a hang."""
+        with self._lock:
+            self._paused = True
+        if self.heartbeat is not None:
+            # proof of life for the supervisor's mtime backstop at the
+            # boundary's entry (its --hang-timeout must still be sized
+            # above the longest boundary — it is the blunt instrument)
+            self.heartbeat.beat(self._step, force=True)
+
+    def resume(self) -> None:
+        with self._lock:
+            self._paused = False
+            # the paused stretch must not count as no-progress time
+            self._last_beat = self._clock()
+        if self.heartbeat is not None:
+            self.heartbeat.beat(self._step, force=True)
+
+    # -- detector side -------------------------------------------------------
+    def stall_threshold_s(self) -> float | None:
+        """Current no-progress budget, or None while still calibrating
+        (fewer than 3 observed step durations — the compile-heavy first
+        steps must not define 'normal')."""
+        with self._lock:
+            if len(self._durs) < 3:
+                return None
+            median = statistics.median(self._durs)
+        return max(self.multiple * median, self.min_timeout_s)
+
+    def check(self, now: float | None = None) -> bool:
+        """One detector pass; -> whether a stall was flagged (test seam —
+        the daemon thread calls this every ``poll_s``)."""
+        if self.triggered:
+            return True
+        threshold = self.stall_threshold_s()
+        with self._lock:
+            last, step, paused = self._last_beat, self._step, self._paused
+        if paused or threshold is None or last is None:
+            return False
+        stalled_s = (self._clock() if now is None else now) - last
+        if stalled_s <= threshold:
+            return False
+        self.triggered = True
+        msg = (f"watchdog: no train-step progress for {stalled_s:.1f}s "
+               f"(threshold {threshold:.1f}s = {self.multiple:g}x trailing "
+               f"median) at step {step}")
+        print(msg, file=sys.stderr, flush=True)
+        if self.telemetry is not None:
+            self.telemetry.instant("watchdog.stall", step=step,
+                                   stalled_s=stalled_s,
+                                   threshold_s=threshold,
+                                   escalate=self.escalate)
+        if self.escalate == "exit":
+            sys.stderr.flush()
+            self._exit(self.exit_code)
+        return True
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "Watchdog":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop,
+                                            name="resilience-watchdog",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            self.check()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)

@@ -82,7 +82,7 @@ class Telemetry:
                 import jax
 
                 rank = jax.process_index()
-            except Exception:
+            except Exception:  # lint: swallow-ok — pre-init default rank
                 rank = 0
         self.rank = rank
         self.host = host or socket.gethostname()

@@ -102,7 +102,7 @@ def peak_flops() -> float | None:
         import bench
 
         return bench.chip_peak_flops()
-    except Exception:
+    except Exception:  # lint: swallow-ok — optional probe, None = omit MFU
         return None
 
 
@@ -123,7 +123,7 @@ def step_flops_estimate(trainer, batch) -> float | None:
             return None
         n_subb = int(trainer.model.config.get("n_subb", 1) or 1)
         return fl * n_subb if n_subb > 1 else fl
-    except Exception:
+    except Exception:  # lint: swallow-ok — cost analysis is best-effort
         return None
 
 
@@ -140,7 +140,7 @@ def device_memory_stats() -> dict | None:
         import jax
 
         stats = jax.local_devices()[0].memory_stats()
-    except Exception:
+    except Exception:  # lint: swallow-ok — backends without memory stats
         return None
     if not stats:
         return None

@@ -129,11 +129,16 @@ class Checkpointer:
     """
 
     def __init__(self, directory: str, keep: int = 3,
-                 async_save: bool = False, telemetry=None):
+                 async_save: bool = False, telemetry=None,
+                 fault_plan=None):
         self.directory = directory
         self.keep = keep
         self.async_save = async_save
         self.telemetry = telemetry
+        # ISSUE 4: deterministic `checkpoint:fail@EPOCH` injection — lets
+        # tier-1 tests exercise the writer-failure path (the error is
+        # delivered at the next join, exactly like a real disk failure)
+        self.fault_plan = fault_plan
         self._inflight: SaveHandle | None = None
         #: test seam: called on the writer between serialization and the
         #: atomic publish — a sleep makes the writer observably slow, a
@@ -151,7 +156,7 @@ class Checkpointer:
             if f.endswith(".tmp.npz") or f == "latest.json.tmp":
                 try:
                     os.remove(os.path.join(self.directory, f))
-                except OSError:
+                except OSError:  # lint: swallow-ok
                     pass  # concurrent cleanup / permissions: not fatal
 
     def _path(self, epoch: int) -> str:
@@ -232,6 +237,10 @@ class Checkpointer:
         mode, inline in sync mode — one code path, so the published bytes
         are identical either way)."""
         t0 = time.perf_counter()
+        if (self.fault_plan is not None
+                and self.fault_plan.fire("checkpoint", epoch) == "fail"):
+            raise OSError(f"injected checkpoint write failure "
+                          f"(epoch {epoch})")
         tmp = handle.path + ".tmp.npz"
         np.savez(tmp, **flat)
         if self._pre_publish_hook is not None:

@@ -169,7 +169,12 @@ class Recorder:
             if os.path.exists(p):
                 loaded = np.load(p, allow_pickle=True).item()
                 hist.clear()
-                hist.update({k: list(v) for k, v in loaded.items()})
+                # tolist(), not list(): numpy scalars (np.int64 epochs)
+                # must not leak into the histories — a later save() would
+                # fail json-serializing summary.json (resume, then train
+                # more, then save — the supervisor's bread and butter)
+                hist.update({k: np.asarray(v).tolist()
+                             for k, v in loaded.items()})
 
 
 def write_history_snapshot(snapshot: dict, path: str) -> None:
