@@ -183,9 +183,14 @@ def test_prune_ignores_tmp_debris(tmp_path):
     debris.touch()
     ck.save(4, 4, {"params": TREE})
     real = sorted(f for f in os.listdir(tmp_path)
-                  if f.startswith("ckpt_e") and not f.endswith(".tmp.npz"))
-    # keep=2 of the REAL checkpoints: 2 and 4 survive (debris uncounted)
+                  if f.startswith("ckpt_e") and f.endswith(".npz")
+                  and not f.endswith(".tmp.npz"))
+    # keep=2 of the REAL checkpoints: 2 and 4 survive (debris uncounted;
+    # since ISSUE 5 each survivor also has its ckpt_eNNNN.manifest.json)
     assert real == ["ckpt_e0002.npz", "ckpt_e0004.npz"]
+    assert sorted(f for f in os.listdir(tmp_path)
+                  if f.endswith(".manifest.json")) == [
+        "ckpt_e0002.manifest.json", "ckpt_e0004.manifest.json"]
     assert debris.exists()  # prune never deletes debris; init sweeps it
     ck2 = Checkpointer(str(tmp_path), keep=2)
     assert not debris.exists()

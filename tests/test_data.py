@@ -209,3 +209,53 @@ def test_convert_hkl_tree_with_stubbed_hickle(tmp_path, monkeypatch):
     assert not ds.synthetic and ds.n_train == 12
     batch = next(iter(ds.train_batches(4, epoch=0, seed=0)))
     assert batch["x"].shape == (4, 8, 8, 3) and batch["x"].dtype == np.uint8
+
+
+# -- bounded-retry reads (ISSUE 5 satellite) ----------------------------------
+
+def test_read_with_retry_transient_then_success():
+    from theanompi_tpu.models.data.base import read_with_retry
+
+    sleeps = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient EIO")
+        return "payload"
+
+    out = read_with_retry(flaky, what="x_0000.npy", retries=4,
+                          backoff_s=0.05, sleep=sleeps.append)
+    assert out == "payload" and calls["n"] == 3
+    assert sleeps == [0.05, 0.1]  # doubling backoff, no sleep after success
+
+
+def test_read_with_retry_exhaustion_raises_typed_error():
+    from theanompi_tpu.models.data.base import DataReadError, read_with_retry
+
+    def dead():
+        raise OSError("mount is gone")
+
+    with pytest.raises(DataReadError, match="4 attempts.*mount is gone") \
+            as ei:
+        read_with_retry(dead, what="x_0000.npy", retries=4,
+                        sleep=lambda s: None)
+    assert isinstance(ei.value.__cause__, OSError)
+
+
+def test_shardset_load_raises_data_read_error_after_retries(tmp_path):
+    """The imagenet shard reader goes through the retry wrapper: a shard
+    that vanishes mid-run surfaces as the typed DataReadError, not the
+    first raw IOError (total default backoff is ~0.35 s — bounded, not
+    eternal, and cheap enough to pay for real here)."""
+    from theanompi_tpu.models.data.base import DataReadError
+    from theanompi_tpu.models.data.imagenet import _ShardSet
+
+    path = _fake_tree(tmp_path)
+    s = _ShardSet(os.path.join(path, "train"))
+    x, y = s.load(0)  # healthy read
+    assert len(x) == len(y)
+    os.remove(s.x_files[0])
+    with pytest.raises(DataReadError, match="attempts"):
+        s.load(0)

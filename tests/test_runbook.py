@@ -146,3 +146,32 @@ def test_runbook_exchange_bench_command(tmp_path):
     assert row["collectives"].get("all-reduce", 0) >= 1
     assert row["buckets"]["bucket_bytes"] == 4 * 2**20
     assert row["step_ms"] > 0
+
+
+def test_runbook_checkpoint_scrubber_command(tmp_path, capsys):
+    """The RUNBOOK's checkpoint-hygiene step (ISSUE 5): the exact
+    `python -m theanompi_tpu.utils.checkpoint --verify DIR` scrubber CLI
+    must run, report per-checkpoint verdicts, and exit 0 on a healthy
+    directory / 77 when anything fails verification."""
+    import numpy as np
+
+    from theanompi_tpu.utils import checkpoint as ck_mod
+
+    d = str(tmp_path / "ckpt")
+    ck = ck_mod.Checkpointer(d, keep=5)
+    tree = {"a": np.arange(6, dtype=np.float32)}
+    for e in range(2):
+        ck.save(e, e, {"params": tree})
+    assert ck_mod.main(["--verify", d]) == 0
+    out = capsys.readouterr().out
+    assert "2/2 checkpoints verifiable" in out
+    # rot one file: the scrubber reports it and flips to the exit-code-
+    # contract's checkpoint code (77)
+    path = ck._path(1)
+    with open(path, "r+b") as f:
+        f.seek(os.path.getsize(path) // 2)
+        b = f.read(1)
+        f.seek(os.path.getsize(path) // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    assert ck_mod.main(["--verify", d]) == 77
+    assert "CORRUPT" in capsys.readouterr().out

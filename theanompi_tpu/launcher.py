@@ -158,6 +158,11 @@ def build_parser() -> argparse.ArgumentParser:
                    "repaying the full compile (also: THEANOMPI_COMPILE_CACHE "
                    "env var)")
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--resume-force", action="store_true",
+                   help="override the checkpoint run-fingerprint check: "
+                   "resume even though the mesh / exchange strategy / "
+                   "model config differ from the checkpoint's (ISSUE 5; "
+                   "normally a hard refusal)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quiet", action="store_true")
     sup = p.add_argument_group(
@@ -320,15 +325,18 @@ def _build_configs(args) -> tuple[dict, dict]:
         rule_config.setdefault("sentinel_policy", args.sentinel)
     if args.resume:
         rule_config["resume"] = True
+    if args.resume_force:
+        rule_config["resume_force"] = True
     if args.quiet:
         rule_config["verbose"] = False
     return model_config, rule_config
 
 
 def main(argv: list[str] | None = None) -> int:
-    """Exit-code contract (ISSUE 4; see the README table): 0 clean,
+    """Exit-code contract (ISSUE 4/5; see the README table): 0 clean,
     70 training crash, 75 resumable preemption exit, 76 watchdog hang,
-    78 config error — each reported as ONE ``tmlauncher: ...`` stderr line
+    77 checkpoint recovery chain exhausted, 78 config error — each
+    reported as ONE ``tmlauncher: ...`` stderr line
     (set THEANOMPI_DEBUG=1 for the full traceback), so the supervisor —
     and any outer scheduler — can classify without parsing tracebacks."""
     argv = list(sys.argv[1:]) if argv is None else list(argv)
@@ -337,10 +345,15 @@ def main(argv: list[str] | None = None) -> int:
         return _supervise(argv, args)
 
     from theanompi_tpu.resilience import (
+        EXIT_CKPT,
         EXIT_CONFIG,
         EXIT_CRASH,
         EXIT_PREEMPTED,
         PreemptionExit,
+    )
+    from theanompi_tpu.utils.checkpoint import (
+        CheckpointCorruptError,
+        CheckpointFingerprintError,
     )
 
     # -- config phase: wrong flags/files will not fix themselves ------------
@@ -378,6 +391,18 @@ def main(argv: list[str] | None = None) -> int:
             modelclass=args.modelclass,
             model_config=model_config,
         )
+    except CheckpointFingerprintError as e:
+        # a topology change, not corruption: restarting won't fix it, and
+        # the user holds the override (--resume-force) — config class
+        _error_line("resume", e)
+        return EXIT_CONFIG
+    except CheckpointCorruptError as e:
+        # ISSUE 5: the recovery chain is exhausted — every retained
+        # checkpoint failed verification (the bad files are under
+        # <checkpoint-dir>/corrupt/).  Distinct code: the supervisor must
+        # NOT restart into the same empty chain
+        _error_line("checkpoint", e)
+        return EXIT_CKPT
     except _CONFIG_ERRORS as e:
         _error_line("init", e)
         return EXIT_CONFIG
@@ -395,6 +420,10 @@ def main(argv: list[str] | None = None) -> int:
         return EXIT_PREEMPTED
     except KeyboardInterrupt:
         raise  # a human's ^C is not a crash to classify
+    except CheckpointCorruptError as e:
+        # a sentinel rollback can exhaust the chain mid-training too
+        _error_line("checkpoint", e)
+        return EXIT_CKPT
     except Exception as e:
         _error_line("training", e)
         return EXIT_CRASH

@@ -9,7 +9,46 @@ same via ``file_batch_size`` bookkeeping; SURVEY.md §2.3, unverified).
 
 from __future__ import annotations
 
+import sys
+import time
+
 import numpy as np
+
+
+class DataReadError(RuntimeError):
+    """A dataset read kept failing after the bounded retries — the typed
+    terminal error loaders raise instead of leaking the first IOError
+    (under supervision this is a restartable crash, and the message says
+    which file and how many attempts)."""
+
+
+def read_with_retry(fn, what: str, retries: int = 4,
+                    backoff_s: float = 0.05, sleep=time.sleep):
+    """Run a read callable with bounded exponential-backoff retries.
+
+    ISSUE 5 satellite: shared-filesystem reads (NFS/GCS-fuse shards) fail
+    transiently all the time — a single EIO must cost one short retry, not
+    the whole training attempt.  ``OSError`` (which includes
+    ``FileNotFoundError`` from eventually-consistent mounts) and numpy's
+    ``ValueError`` for a torn partial read are retried ``retries`` times
+    with doubling ``backoff_s``; exhaustion raises the typed
+    :class:`DataReadError` carrying the last cause.
+    """
+    retries = max(1, int(retries))
+    last: Exception | None = None
+    for attempt in range(1, retries + 1):
+        try:
+            return fn()
+        except (OSError, ValueError) as e:
+            last = e
+            if attempt < retries:
+                print(f"data: read of {what} failed "
+                      f"(attempt {attempt}/{retries}): {e}; retrying",
+                      file=sys.stderr, flush=True)
+                sleep(backoff_s * (2 ** (attempt - 1)))
+    raise DataReadError(
+        f"could not read {what} after {retries} attempts: {last}"
+    ) from last
 
 
 class Dataset:

@@ -25,7 +25,7 @@ import os
 
 import numpy as np
 
-from theanompi_tpu.models.data.base import Dataset
+from theanompi_tpu.models.data.base import Dataset, read_with_retry
 
 # ImageNet channel means in [0,255] RGB (the reference subtracted a stored
 # per-pixel mean image; per-channel is the modern equivalent)
@@ -127,12 +127,23 @@ class _ShardSet:
         # one pass over the headers serves both the count and the worker
         # ring's slot size (re-scanning thousands of shards would double
         # dataset construction time)
-        lens = [int(np.load(p, mmap_mode="r").shape[0]) for p in self.x_files]
+        lens = [
+            int(read_with_retry(
+                lambda p=p: np.load(p, mmap_mode="r").shape[0],
+                what=p))
+            for p in self.x_files
+        ]
         self.n = sum(lens)
         self.max_len = max(lens)
 
     def load(self, i: int):
-        return np.load(self.x_files[i]), np.load(self.y_files[i])
+        # bounded-retry reads (ISSUE 5 satellite): a transient EIO on a
+        # shared mount costs a short backoff, not the training attempt;
+        # exhaustion raises the typed DataReadError
+        return (read_with_retry(lambda: np.load(self.x_files[i]),
+                                what=self.x_files[i]),
+                read_with_retry(lambda: np.load(self.y_files[i]),
+                                what=self.y_files[i]))
 
     def spec(self, i: int):
         """Picklable shard handle for pool workers."""
@@ -196,7 +207,9 @@ class _SyntheticShards:
 
 def _load_from_spec(spec):
     if spec[0] == "files":
-        return np.load(spec[1]), np.load(spec[2])
+        # pool workers read the same flaky mounts the inline path does
+        return (read_with_retry(lambda: np.load(spec[1]), what=spec[1]),
+                read_with_retry(lambda: np.load(spec[2]), what=spec[2]))
     _, n, n_classes, store, shard, seed, i = spec
     return _SyntheticShards(n, n_classes, store, shard, seed).load(i)
 
@@ -229,7 +242,9 @@ class ImageNetData(Dataset):
             self.synthetic = False
             self._train = _ShardSet(os.path.join(path, "train"))
             self._val = _ShardSet(os.path.join(path, "val"))
-            probe = np.load(self._train.x_files[0], mmap_mode="r")
+            probe = read_with_retry(
+                lambda: np.load(self._train.x_files[0], mmap_mode="r"),
+                what=self._train.x_files[0])
             self.store_size = int(probe.shape[1])
             if "n_classes" in config:
                 self.n_classes = config["n_classes"]
@@ -238,7 +253,7 @@ class ImageNetData(Dataset):
                 # highest class id, and an undersized head silently clips
                 # labels in take_along_axis
                 ys = [
-                    np.load(p)
+                    read_with_retry(lambda p=p: np.load(p), what=p)
                     for p in (*self._train.y_files, *self._val.y_files)
                 ]
                 self.n_classes = int(max(y.max() for y in ys)) + 1

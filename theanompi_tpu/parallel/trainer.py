@@ -16,6 +16,7 @@ supplies the compiled step + parameter layout:
 
 from __future__ import annotations
 
+import json
 import sys
 import time
 from contextlib import nullcontext
@@ -331,6 +332,8 @@ class BaseTrainer:
                  seed: int = 0, prefetch_depth: int = 2,
                  checkpoint_dir: str | None = None, checkpoint_keep: int = 3,
                  checkpoint_async: bool = True,
+                 checkpoint_verify: str = "auto",
+                 resume_force: bool = False,
                  profile_dir: str | None = None,
                  profile_window: tuple[int, int] = (10, 20),
                  telemetry=None,
@@ -354,15 +357,24 @@ class BaseTrainer:
         self._preempt_guard = None
         self._epoch_start_iter = 0
         self.checkpointer = None
+        if checkpoint_verify not in ("auto", "fast", "full", "none"):
+            raise ValueError(
+                f"checkpoint_verify must be auto/fast/full/none, "
+                f"got {checkpoint_verify!r}")
+        self.checkpoint_verify = checkpoint_verify
         if checkpoint_dir:
             from theanompi_tpu.utils.checkpoint import Checkpointer
 
             # async by default (ISSUE 3): the boundary only pays the
-            # snapshot; serialization/publish/prune run on the writer
+            # snapshot; serialization/publish/prune run on the writer.
+            # The fingerprint is the bound method, resolved lazily —
+            # subclasses set self.exchanger after this constructor runs
             self.checkpointer = Checkpointer(
                 checkpoint_dir, keep=checkpoint_keep,
                 async_save=checkpoint_async, telemetry=telemetry,
-                fault_plan=self.fault_plan)
+                fault_plan=self.fault_plan,
+                fingerprint=self._run_fingerprint,
+                resume_force=resume_force)
         self.optimizer = model.build_optimizer()
         self.global_batch = model.batch_size * self.n_workers
         self._step_fn = None
@@ -512,6 +524,29 @@ class BaseTrainer:
             "opt_state": self.opt_state,
         }
 
+    def _run_fingerprint(self) -> dict:
+        """The run-topology fingerprint stamped into checkpoint manifests
+        (ISSUE 5): resuming under a different mesh, exchange strategy,
+        accumulation depth, or model config is a hard refusal unless
+        ``resume_force`` — a silent topology change corrupts the lineage
+        (zero1 opt-state shards, stacked EASGD/GOSGD worker axes, and RNG
+        streams all depend on it).  ``n_epochs``/``verbose`` are excluded:
+        extending or quieting a run is a legitimate resume.
+        """
+        import hashlib
+
+        cfg = {k: repr(v) for k, v in self.model.config.items()
+               if k not in ("n_epochs", "verbose")}
+        blob = json.dumps(cfg, sort_keys=True).encode()
+        exch = getattr(self, "exchanger", None)
+        return {
+            "mesh": {str(a): int(s) for a, s in self.mesh.shape.items()},
+            "exchange": getattr(exch, "strategy", type(self).__name__),
+            "n_subb": int(self.model.config.get("n_subb", 1) or 1),
+            "model": type(self.model).__name__,
+            "model_config_sha": hashlib.sha256(blob).hexdigest()[:16],
+        }
+
     def save_checkpoint(self, epoch: int):
         """Kick off a checkpoint save; -> SaveHandle (or None, no dir).
 
@@ -528,21 +563,42 @@ class BaseTrainer:
             epoch, self.iteration, self.checkpoint_trees(),
             recorder_snapshot=self.recorder.history_snapshot())
 
+    def _resume_verify_level(self) -> str:
+        """ISSUE 5 verify policy: the cheap structural check always; the
+        full per-leaf hash read exactly when it pays — the first resume
+        after a non-clean exit (the previous writer never reached its
+        clean-shutdown handshake, or this is a supervised restart), which
+        is when torn writes and half-copied files actually appear."""
+        if self.checkpoint_verify != "auto":
+            return self.checkpoint_verify
+        from theanompi_tpu.resilience.faults import current_attempt
+
+        if self.checkpointer.was_unclean() or current_attempt() > 1:
+            return "full"
+        return "fast"
+
     def try_resume(self) -> bool:
-        """Restore the latest checkpoint if one exists; -> resumed or not.
+        """Restore the newest *verifiable* checkpoint; -> resumed or not.
 
         Call after ``init_state`` (the fresh state is the restore template,
-        carrying pytree structure and shardings)."""
+        carrying pytree structure and shardings).  Goes through the
+        checkpoint recovery chain (ISSUE 5): corrupt checkpoints are
+        quarantined and stepped over; an exhausted chain raises
+        :class:`~theanompi_tpu.utils.checkpoint.CheckpointChainExhausted`
+        (tmlauncher exit 77) and a run-topology mismatch raises
+        :class:`~theanompi_tpu.utils.checkpoint.CheckpointFingerprintError`
+        unless ``resume_force`` is set."""
         if self.checkpointer is None:
             return False
-        epoch = self.checkpointer.latest_epoch()
-        if epoch is None:
+        res = self.checkpointer.load_latest_verified(
+            self.checkpoint_trees(), verify=self._resume_verify_level())
+        if res is None:
             return False
-        restored = self.checkpointer.load(epoch, self.checkpoint_trees())
+        epoch, iteration, restored = res
         for name, tree in restored.items():
             setattr(self, name, tree)  # params/state/opt_state + rule extras
         self.epoch = epoch + 1  # that epoch completed
-        self.iteration = self.checkpointer.latest_iteration()
+        self.iteration = iteration
         self.recorder.load(self.checkpointer.directory)
         if self.recorder.verbose:
             print(f"resumed from epoch {epoch} "
@@ -902,33 +958,43 @@ class BaseTrainer:
         return True
 
     def _handle_rollback(self, e: SentinelRollback) -> None:
-        """Reload the latest checkpoint in-process (sentinel 'rollback')."""
+        """Reload the newest *verifiable* checkpoint in-process (sentinel
+        'rollback').  Goes through the recovery chain (ISSUE 5): a
+        NaN-triggered rollback whose latest checkpoint is corrupt
+        quarantines it and lands on the verified ancestor instead of
+        re-raising into a crash loop; an exhausted chain propagates as the
+        typed checkpoint error (exit 77 under the launcher).  Still
+        bounded by the existing ``sentinel_max_rollbacks`` budget."""
         self.sentinel.rollbacks += 1
-        latest = (self.checkpointer.latest_epoch()
-                  if self.checkpointer is not None else None)
-        if latest is None or self.sentinel.rollbacks > self.sentinel.max_rollbacks:
-            why = ("no checkpoint to roll back to" if latest is None else
+        if (self.checkpointer is None
+                or self.sentinel.rollbacks > self.sentinel.max_rollbacks):
+            why = ("no checkpoint dir to roll back from"
+                   if self.checkpointer is None else
                    f"rollback budget exhausted "
                    f"({self.sentinel.max_rollbacks})")
             raise NonFiniteLossError(
                 f"non-finite loss at step {e.step}; {why}", step=e.step
             ) from e
         print(f"sentinel: non-finite loss at step {e.step}; rolling back "
-              f"to checkpoint epoch {latest} "
+              f"to the newest verifiable checkpoint "
               f"({self.sentinel.rollbacks}/{self.sentinel.max_rollbacks})",
               file=sys.stderr, flush=True)
-        if self.telemetry is not None:
-            self.telemetry.instant("sentinel.rollback", step=e.step,
-                                   restore_epoch=latest,
-                                   rollback=self.sentinel.rollbacks)
         self.sentinel.reset_pending()  # pending losses describe a dead timeline
         if self._watchdog is not None:
             self._watchdog.pause()  # restore I/O + re-placement is beat-free
         try:
-            self.try_resume()
+            resumed = self.try_resume()
         finally:
             if self._watchdog is not None:
                 self._watchdog.resume()
+        if not resumed:
+            raise NonFiniteLossError(
+                f"non-finite loss at step {e.step}; no checkpoint to roll "
+                f"back to", step=e.step) from e
+        if self.telemetry is not None:
+            self.telemetry.instant("sentinel.rollback", step=e.step,
+                                   restore_epoch=self.epoch - 1,
+                                   rollback=self.sentinel.rollbacks)
         self._step_dev = None  # restored iteration needs a fresh device scalar
 
     def _run_epochs(self, stop=None) -> None:
@@ -1062,6 +1128,11 @@ class BaseTrainer:
                 self._watchdog.stop()
                 self._watchdog = None
             saved = self._preemption_checkpoint()
+            if self.checkpointer is not None:
+                # the preemption checkpoint is synchronous and complete:
+                # drop the dirty marker so the resumed attempt takes the
+                # cheap fast verify, not the full hash read
+                self.checkpointer.mark_clean()
             if self.telemetry is not None:
                 self.telemetry.instant("preempt.exit", epoch=self.epoch,
                                        iteration=self.iteration,
@@ -1102,6 +1173,11 @@ class BaseTrainer:
                     except Exception as e:
                         print(f"checkpoint writer failed during teardown: "
                               f"{e}", file=sys.stderr)
+        if self.checkpointer is not None:
+            # clean-shutdown handshake (ISSUE 5): only a run that reaches
+            # this line drops the dirty marker — the next resume of a
+            # marker-holding directory pays the full-hash verify
+            self.checkpointer.mark_clean()
         self.recorder.save()
         model.cleanup()
         return self.recorder
@@ -1139,6 +1215,10 @@ class Rule:
             checkpoint_dir=self.config.get("checkpoint_dir"),
             checkpoint_keep=self.config.get("checkpoint_keep", 3),
             checkpoint_async=self.config.get("checkpoint_async", True),
+            # ISSUE 5: verify mode (auto = fast, full after unclean exit)
+            # and the fingerprint-mismatch override (--resume-force)
+            checkpoint_verify=self.config.get("checkpoint_verify", "auto"),
+            resume_force=bool(self.config.get("resume_force", False)),
             profile_dir=self.config.get("profile_dir"),
             profile_window=tuple(self.config.get("profile_window", (10, 20))),
             telemetry=self.make_telemetry(),

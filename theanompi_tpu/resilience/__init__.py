@@ -28,6 +28,7 @@ import os
 from dataclasses import dataclass
 
 from theanompi_tpu.resilience.supervisor import (  # noqa: F401
+    EXIT_CKPT,
     EXIT_CLEAN,
     EXIT_CONFIG,
     EXIT_CRASH,
@@ -35,6 +36,10 @@ from theanompi_tpu.resilience.supervisor import (  # noqa: F401
     EXIT_PREEMPTED,
     Supervisor,
     classify_exit,
+)
+from theanompi_tpu.resilience.events import (  # noqa: F401
+    read_events,
+    record_event,
 )
 from theanompi_tpu.resilience.faults import (  # noqa: F401
     FaultInjected,

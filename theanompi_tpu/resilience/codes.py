@@ -11,4 +11,7 @@ EXIT_CLEAN = 0
 EXIT_CRASH = 70      # EX_SOFTWARE: unhandled training exception
 EXIT_PREEMPTED = 75  # EX_TEMPFAIL: clean resumable preemption exit
 EXIT_HANG = 76       # EX_PROTOCOL (repurposed): watchdog-confirmed stall
+EXIT_CKPT = 77       # EX_NOPERM (repurposed): checkpoint recovery chain
+#                      exhausted — no verifiable checkpoint to resume from
+#                      (fatal: a restart would walk the same empty chain)
 EXIT_CONFIG = 78     # EX_CONFIG: bad flags/config/model import

@@ -1,0 +1,72 @@
+"""Crash-safe shared event log inside ``resilience.json`` (ISSUE 5).
+
+Two writers share that file: the supervisor rewrites the whole attempt
+summary after every attempt, and the checkpoint recovery chain appends
+``ckpt.quarantine`` / ``ckpt.fallback`` events from inside the training
+process.  They never run concurrently (the supervisor only writes between
+attempts), but each must preserve the other's records: this module owns
+the ``events`` list — read-modify-write with the same ``os.replace``
+crash-safety contract the summary uses — and the supervisor's summary
+rewrite carries any existing ``events`` forward.
+
+A leaf module (stdlib only): ``utils.checkpoint`` imports it without
+pulling in the supervisor's subprocess machinery.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+
+def read_events(path: str) -> list[dict]:
+    """The ``events`` list of a resilience.json, or ``[]``."""
+    data = _read(path)
+    events = data.get("events")
+    return events if isinstance(events, list) else []
+
+
+def _read(path: str) -> dict:
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            loaded = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"resilience: unreadable {path} ({e}); starting a fresh "
+              f"event list", file=sys.stderr, flush=True)
+        return {}
+    return loaded if isinstance(loaded, dict) else {}
+
+
+def record_event(path: str, name: str, **fields) -> None:
+    """Append one event, atomically rewriting the file.
+
+    Best-effort by design: the chain records its fallback while actively
+    recovering a run — a dead audit disk must not abort the recovery it is
+    auditing (the failure is reported to stderr, never silently dropped).
+    """
+    data = _read(path)
+    events = data.setdefault("events", [])
+    # wall-clock stamp, not a duration: this is an audit record a human
+    # correlates with scheduler logs
+    events.append({"ts": time.time(), "name": name,  # lint: wall-ok
+                   **fields})
+    # per-writer tmp name: the scrubber CLI may quarantine against a
+    # directory whose live writer thread is scrubbing too — a shared
+    # ".tmp" would let one writer publish the other's half-written file.
+    # The os.replace itself stays atomic; a lost UPDATE between two truly
+    # simultaneous read-modify-writes remains possible and is accepted
+    # for an advisory audit log (locking here could block a recovery)
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    try:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1)
+        os.replace(tmp, path)
+    except OSError as e:
+        print(f"resilience: could not record {name!r} in {path}: {e}",
+              file=sys.stderr, flush=True)

@@ -21,6 +21,13 @@ specs separated by ``;`` or ``,``)::
                          (exercises stall_timeout / PrefetchStallError)
     prefetch:raise@3     the source iterator raises at batch 3
     checkpoint:fail@1    Checkpointer._write raises OSError for epoch 1
+    checkpoint:truncate@1       ISSUE 5 corruption sites (ckpt_truncate /
+    checkpoint:bitflip@1        ckpt_bitflip / ckpt_manifest_drop): damage
+    checkpoint:manifest_drop@1  epoch 1's PUBLISHED files post-commit —
+                         truncate the .npz to half, flip one mid-file byte,
+                         or delete the manifest — so the verified recovery
+                         chain (fallback, quarantine, exit 77) is
+                         exercisable in tier-1 CPU tests
 
 ``INDEX`` is the global step for ``step``, the batch ordinal for
 ``prefetch``, and the epoch for ``checkpoint``.  The optional ``ATTEMPT``
@@ -53,7 +60,7 @@ class FaultPlanError(ValueError):
 SITES = {
     "step": ("raise", "kill", "nan"),
     "prefetch": ("stall", "raise"),
-    "checkpoint": ("fail",),
+    "checkpoint": ("fail", "truncate", "bitflip", "manifest_drop"),
 }
 
 

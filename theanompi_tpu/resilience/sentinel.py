@@ -15,9 +15,12 @@ of three policies:
   state when the step was poisoned, so one bad batch costs one skipped
   update instead of the run.  The skip count is bounded
   (``sentinel_max_skips``); exhausting it raises.
-- ``rollback``: reload the latest checkpoint **in-process** (bounded by
-  ``sentinel_max_rollbacks``) and replay from there — for the transient
-  blow-up an LR schedule or bad shard causes once.
+- ``rollback``: reload the latest **verifiable** checkpoint in-process
+  (bounded by ``sentinel_max_rollbacks``) and replay from there — for the
+  transient blow-up an LR schedule or bad shard causes once.  Since
+  ISSUE 5 the reload goes through the checkpoint recovery chain: a corrupt
+  latest checkpoint is quarantined and the rollback lands on the newest
+  verified ancestor instead of re-raising into a crash loop.
 
 Detection honesty: the host-side check only *materializes* loss scalars
 at the recorder's fenced print boundaries (per-step blocking would

@@ -42,12 +42,14 @@ import threading
 import time
 
 from theanompi_tpu.resilience.codes import (
+    EXIT_CKPT,
     EXIT_CLEAN,
     EXIT_CONFIG,
     EXIT_CRASH,
     EXIT_HANG,
     EXIT_PREEMPTED,
 )
+from theanompi_tpu.resilience.events import read_events
 from theanompi_tpu.resilience.watchdog import heartbeat_age_s
 
 #: restart-budget-exempt preemptions still need SOME bound, or a
@@ -56,7 +58,8 @@ MAX_PREEMPTIONS = 64
 
 
 def classify_exit(returncode: int) -> str:
-    """-> 'clean' | 'preemption' | 'hang' | 'config' | 'crash'."""
+    """-> 'clean' | 'preemption' | 'hang' | 'config' | 'checkpoint' |
+    'crash'."""
     if returncode == EXIT_CLEAN:
         return "clean"
     # -SIGTERM: the preemptor's signal landed before (or instead of) the
@@ -66,6 +69,11 @@ def classify_exit(returncode: int) -> str:
         return "preemption"
     if returncode == EXIT_HANG:
         return "hang"
+    # ISSUE 5: the child's checkpoint recovery chain is exhausted — every
+    # retained checkpoint failed verification and was quarantined.  A
+    # restart would walk the same (now empty) chain: fatal, like config
+    if returncode == EXIT_CKPT:
+        return "checkpoint"
     # 2 is argparse's usage-error exit
     if returncode in (EXIT_CONFIG, 2):
         return "config"
@@ -243,6 +251,16 @@ class Supervisor:
                           "child's shutdown (no restart)")
                 final = rc if rc > 0 else EXIT_PREEMPTED
                 break
+            if cause == "checkpoint":
+                # no verifiable checkpoint left (the child already walked
+                # the whole recovery chain and quarantined every rung):
+                # restarting replays the same exhausted walk
+                self._log(f"attempt {attempt} exhausted the checkpoint "
+                          f"recovery chain (exit {rc}); not restarting — "
+                          f"inspect <checkpoint-dir>/corrupt/ and "
+                          f"resilience.json")
+                final = rc
+                break
             if cause == "config":
                 if attempt == 1:
                     self._log(f"attempt 1 exited with a config error "
@@ -305,13 +323,20 @@ class Supervisor:
 
     def _write_summary(self, **kw) -> None:
         """Crash-safe rewrite after every attempt, not just at the end —
-        a supervisor killed mid-run still leaves the attempt record."""
+        a supervisor killed mid-run still leaves the attempt record.
+        ``events`` recorded into the same file by the child's checkpoint
+        recovery chain (ISSUE 5: ``ckpt.fallback``/``ckpt.quarantine``)
+        are carried forward, never clobbered by the rewrite."""
         path = self.resilience_path
+        data = self.summary(**kw)
+        events = read_events(path)
+        if events:
+            data["events"] = events
         try:
             os.makedirs(os.path.dirname(os.path.abspath(path)),
                         exist_ok=True)
             with open(path + ".tmp", "w") as f:
-                json.dump(self.summary(**kw), f, indent=1)
+                json.dump(data, f, indent=1)
             os.replace(path + ".tmp", path)
         except OSError as e:
             self._log(f"could not write {path}: {e}")

@@ -1,4 +1,4 @@
-"""Checkpoint/resume: async per-epoch pytree snapshots + recorder histories.
+"""Checkpoint/resume: async per-epoch pytree snapshots + verified recovery.
 
 Reference (unverified — SURVEY.md §5): rank-0 (or the EASGD server) saved
 ``params`` as ``.npy`` per epoch via ``Weight.save()``/helper save; resume
@@ -11,8 +11,7 @@ EASGD center or GOSGD weights) is flattened by key path into one ``.npz``
 per epoch, with a ``latest`` pointer and bounded retention.  Restore needs a
 template (the freshly initialized state) so pytree structure and shardings
 come from the trainer, not the file — arrays are placed back with each
-template leaf's sharding, making checkpoints portable across mesh shapes as
-long as the logical state matches.
+template leaf's sharding.
 
 **Async engine (ISSUE 3)** — the save is split into two phases so the host
 write leaves the training thread's critical path (the t5x/orbax-style
@@ -31,23 +30,87 @@ async-snapshot shape):
 - ``write`` (background writer thread, ``checkpoint.write`` span with byte
   and duration gauges): ``np.savez`` serialization, atomic publish
   (``os.replace`` + ``latest.json`` — the crash-safety contract is
-  unchanged), recorder-history write, retention prune.
+  unchanged), recorder-history write, retention prune, and an opportunistic
+  integrity scrub of one older checkpoint.
 
 At most one save is in flight: the next save / a load / exit joins the
 previous via :meth:`Checkpointer.join_pending`, and a writer exception is
 re-raised at that join — never swallowed.
+
+**Integrity layer (ISSUE 5)** — resume must survive corrupt, torn, or
+mismatched checkpoints, because every resilience path (supervised restart,
+sentinel ``rollback``, cold ``--resume``) trusts these bytes:
+
+- every save publishes a ``ckpt_eNNNN.manifest.json`` next to the ``.npz``:
+  per-leaf CRC32 (stdlib ``zlib.crc32`` — the CRC32C/xxhash role; no
+  third-party hash libs in this image), shapes/dtypes/byte counts, the
+  epoch's iteration, and a **run fingerprint** (mesh axes/shape, exchange
+  strategy, ``n_subb``, model-config hash).  The manifest is replaced into
+  place *before* the ``.npz`` so a published checkpoint always has one —
+  a torn publish leaves at most an orphan manifest, swept at init;
+- :meth:`Checkpointer.load` verifies first: ``fast`` (manifest present,
+  archive readable, leaf set matches — always) or ``full`` (per-leaf CRC —
+  the first resume after a non-clean exit, witnessed by the ``dirty``
+  marker file a saving session holds until it exits cleanly).  Failures
+  raise the typed :class:`CheckpointCorruptError`;
+- the **recovery chain** (:meth:`Checkpointer.load_latest_verified`): when
+  the newest checkpoint fails verification it is quarantined under
+  ``<dir>/corrupt/`` and the loader steps back to the newest *verifiable*
+  one, recording ``ckpt.fallback`` in ``<dir>/resilience.json`` and
+  telemetry; an exhausted chain raises
+  :class:`CheckpointChainExhausted` (``tmlauncher`` exit ``EXIT_CKPT=77``);
+- a **fingerprint mismatch** (resuming under a different mesh / exchange
+  strategy / model config) is a hard refusal —
+  :class:`CheckpointFingerprintError` — unless ``resume_force`` is set,
+  because silently restoring into a different topology is worse than
+  stopping;
+- the **scrubber**: ``python -m theanompi_tpu.utils.checkpoint --verify
+  <dir>`` full-hash-verifies every retained checkpoint (exit 77 if any
+  fail), and the background writer scrubs one older checkpoint per save in
+  its idle time so rot is found *before* the resume that needs it.
+
+``_prune`` counts only checkpoints that pass fast verification toward
+``keep`` and never deletes the newest verifiable one — n corrupt newer
+files can no longer rotate a run's only good ancestor out of existence.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 import time
+import zipfile
+import zlib
 from contextlib import nullcontext
 
 import jax
 import numpy as np
+
+#: manifest schema version (bump on incompatible change)
+MANIFEST_VERSION = 1
+
+
+class CheckpointError(RuntimeError):
+    """Base class for typed checkpoint failures."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """A checkpoint failed verification (torn write, bit-flip, missing or
+    malformed manifest, unreadable archive)."""
+
+
+class CheckpointChainExhausted(CheckpointCorruptError):
+    """Checkpoints existed, but none survived verification — there is
+    nothing trustworthy to resume from (``tmlauncher`` exits 77)."""
+
+
+class CheckpointFingerprintError(CheckpointError):
+    """The checkpoint was written under a different run topology (mesh /
+    exchange strategy / n_subb / model config).  A hard refusal, not a
+    corruption: falling back to an older checkpoint would mismatch too.
+    Override with ``--resume-force`` / the ``resume_force`` rule key."""
 
 
 def _to_host(leaf) -> np.ndarray:
@@ -90,6 +153,165 @@ def _restore_into(template, arrays: dict[str, np.ndarray]):
     )
 
 
+# -- integrity primitives ----------------------------------------------------
+
+def _manifest_path(npz_path: str) -> str:
+    """``.../ckpt_e0001.npz`` -> ``.../ckpt_e0001.manifest.json``."""
+    return npz_path[: -len(".npz")] + ".manifest.json"
+
+
+def _leaf_crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def build_manifest(epoch: int, iteration: int,
+                   flat: dict[str, np.ndarray],
+                   fingerprint: dict | None) -> dict:
+    """Deterministic manifest for a flat leaf dict: no timestamps, sorted
+    keys at serialization time — async and sync saves of the same state
+    must produce byte-identical manifests (tested)."""
+    return {
+        "format": MANIFEST_VERSION,
+        "epoch": int(epoch),
+        "iteration": int(iteration),
+        "fingerprint": fingerprint,
+        "leaves": {
+            k: {
+                "shape": list(a.shape),
+                "dtype": str(a.dtype),
+                "nbytes": int(a.nbytes),
+                "crc32": _leaf_crc(a),
+            }
+            for k, a in flat.items()
+        },
+    }
+
+
+def _check_leaf(name: str, key: str, meta: dict, arr: np.ndarray) -> None:
+    """One leaf against its manifest entry (shape/dtype + CRC32); raises
+    :class:`CheckpointCorruptError`.  Shared between :func:`verify_file`'s
+    full pass and the single-read verified load path."""
+    if (list(arr.shape) != list(meta["shape"])
+            or str(arr.dtype) != meta["dtype"]):
+        raise CheckpointCorruptError(
+            f"{name}: leaf {key!r} is "
+            f"{arr.dtype}{tuple(arr.shape)}, manifest says "
+            f"{meta['dtype']}{tuple(meta['shape'])}")
+    crc = _leaf_crc(arr)
+    if crc != int(meta["crc32"]):
+        raise CheckpointCorruptError(
+            f"{name}: leaf {key!r} CRC mismatch "
+            f"(manifest {int(meta['crc32']):#010x}, "
+            f"file {crc:#010x}) — bit-flip or partial copy")
+
+
+def _epoch_of(fname: str) -> int | None:
+    """``ckpt_e0003.npz`` -> 3; ``None`` for a foreign file that happens
+    to match the retention glob (``ckpt_e0003.bak.npz``) — such files are
+    skipped, never verified, quarantined, or pruned."""
+    try:
+        return int(fname[len("ckpt_e"):-len(".npz")])
+    except ValueError:
+        return None
+
+
+def verify_file(npz_path: str, level: str = "full") -> dict:
+    """Verify one checkpoint file against its manifest; -> the manifest.
+
+    ``fast``: manifest present and well-formed, archive's member set
+    matches the manifest's leaf set (a cheap central-directory read —
+    catches truncation, torn publishes, and missing manifests).
+    ``full``: additionally reads every leaf and checks shape/dtype and the
+    per-leaf CRC32 against the manifest (catches bit-flips and partial
+    copies the zip structure survived).
+
+    Raises :class:`CheckpointCorruptError`; never quarantines or mutates —
+    callers own the consequences (chain fallback, scrub, CLI report).
+    """
+    if level not in ("fast", "full"):
+        raise ValueError(f"verify level must be 'fast' or 'full', "
+                         f"got {level!r}")
+    name = os.path.basename(npz_path)
+    mpath = _manifest_path(npz_path)
+    if not os.path.exists(npz_path):
+        raise CheckpointCorruptError(f"{name}: checkpoint file missing")
+    if not os.path.exists(mpath):
+        raise CheckpointCorruptError(
+            f"{name}: manifest {os.path.basename(mpath)} missing "
+            f"(torn publish, or a pre-integrity checkpoint — re-save, or "
+            f"resume once with checkpoint_verify='none')")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"{name}: unreadable manifest: {e}") from e
+    leaves = manifest.get("leaves")
+    if not isinstance(leaves, dict) or not leaves:
+        raise CheckpointCorruptError(f"{name}: malformed manifest "
+                                     f"(no leaf table)")
+    try:
+        with zipfile.ZipFile(npz_path) as z:
+            members = {n[:-len(".npy")] if n.endswith(".npy") else n
+                       for n in z.namelist()}
+    except (OSError, zipfile.BadZipFile) as e:
+        raise CheckpointCorruptError(
+            f"{name}: unreadable archive (truncated/torn?): {e}") from e
+    if members != set(leaves):
+        missing = sorted(set(leaves) - members)[:3]
+        extra = sorted(members - set(leaves))[:3]
+        raise CheckpointCorruptError(
+            f"{name}: leaf set differs from manifest "
+            f"(missing {missing}, unexpected {extra})")
+    if level == "full":
+        try:
+            with np.load(npz_path) as z:
+                for key, meta in leaves.items():
+                    _check_leaf(name, key, meta, z[key])
+        except CheckpointCorruptError:
+            raise
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile) as e:
+            # zipfile's own per-member CRC can fire first ("Bad CRC-32")
+            raise CheckpointCorruptError(
+                f"{name}: read failed during full verify: {e}") from e
+    return manifest
+
+
+def _normalize_fp(fp: dict) -> dict:
+    """JSON round-trip so an in-memory fingerprint (int mesh sizes, tuples)
+    compares equal to one read back from a manifest."""
+    return json.loads(json.dumps(fp, sort_keys=True))
+
+
+def check_fingerprint(manifest: dict, mine: dict | None,
+                      npz_path: str, force: bool = False) -> None:
+    """Refuse a topology mismatch (or warn, under ``force``).
+
+    Skipped when either side carries no fingerprint (bare library use,
+    pre-integrity manifests) — absence is not a mismatch.
+    """
+    theirs = manifest.get("fingerprint")
+    if theirs is None or mine is None:
+        return
+    mine = _normalize_fp(mine)
+    theirs = _normalize_fp(theirs)
+    if mine == theirs:
+        return
+    diffs = ", ".join(
+        f"{k}: checkpoint={theirs.get(k)!r} != run={mine.get(k)!r}"
+        for k in sorted(set(theirs) | set(mine))
+        if theirs.get(k) != mine.get(k))
+    msg = (f"{os.path.basename(npz_path)}: run fingerprint mismatch — this "
+           f"checkpoint was written under a different topology ({diffs}). "
+           f"Resuming would desynchronize or silently retrain; pass "
+           f"--resume-force (rule key resume_force=True) to override.")
+    if force:
+        print(f"checkpoint: WARNING: {msg} — proceeding (resume_force)",
+              file=sys.stderr, flush=True)
+        return
+    raise CheckpointFingerprintError(msg)
+
+
 class SaveHandle:
     """One (possibly in-flight) checkpoint save.
 
@@ -119,48 +341,115 @@ class SaveHandle:
 
 
 class Checkpointer:
-    """Directory of ``ckpt_eNNNN.npz`` files + ``latest.json`` pointer.
+    """Directory of ``ckpt_eNNNN.npz`` + ``.manifest.json`` pairs with a
+    ``latest.json`` pointer, verified retention, and a recovery chain.
 
-    ``async_save=True`` runs serialization/publish/prune on a background
-    writer thread (see module docstring); the default for a bare
+    ``async_save=True`` runs serialization/publish/prune/scrub on a
+    background writer thread (see module docstring); the default for a bare
     ``Checkpointer`` stays synchronous so direct library use keeps the old
     semantics — the trainer opts into async via its ``checkpoint_async``
     config (default on).
+
+    ``fingerprint`` is a dict or zero-arg callable describing the run
+    topology (the trainer passes its bound ``_run_fingerprint``; resolved
+    lazily so rule subclasses can finish construction first).
+    ``resume_force=True`` downgrades a fingerprint mismatch on load from a
+    hard refusal to a stderr warning.
     """
 
     def __init__(self, directory: str, keep: int = 3,
                  async_save: bool = False, telemetry=None,
-                 fault_plan=None):
+                 fault_plan=None, fingerprint=None,
+                 resume_force: bool = False, sweep_debris: bool = True):
         self.directory = directory
         self.keep = keep
         self.async_save = async_save
         self.telemetry = telemetry
-        # ISSUE 4: deterministic `checkpoint:fail@EPOCH` injection — lets
-        # tier-1 tests exercise the writer-failure path (the error is
-        # delivered at the next join, exactly like a real disk failure)
+        # ISSUE 4/5: deterministic `checkpoint:ACTION@EPOCH` injection —
+        # `fail` raises on the writer (delivered at the next join, exactly
+        # like a real disk failure); `truncate`/`bitflip`/`manifest_drop`
+        # corrupt the PUBLISHED files post-commit, so tier-1 tests can
+        # exercise every branch of the verified recovery chain
         self.fault_plan = fault_plan
+        self.fingerprint = fingerprint
+        self.resume_force = resume_force
         self._inflight: SaveHandle | None = None
         #: test seam: called on the writer between serialization and the
         #: atomic publish — a sleep makes the writer observably slow, a
         #: raise simulates a crash mid-write (tmp written, never published)
         self._pre_publish_hook = None
+        self._marked_dirty = False
+        #: fast-verify verdicts keyed by filename -> ((mtime, size), ok)
+        self._verify_cache: dict[str, tuple] = {}
+        #: (filename, mtime, size) triples already full-scrubbed
+        self._scrubbed: set[tuple] = set()
         os.makedirs(directory, exist_ok=True)
-        self._sweep_tmp()
+        # sweep_debris=False: for tooling (the scrubber CLI) that attaches
+        # to a directory a LIVE writer may be using — sweeping its .tmp
+        # files or a manifest published microseconds before its .npz would
+        # sabotage an in-flight save
+        if sweep_debris:
+            self._sweep_tmp()
 
     def _sweep_tmp(self) -> None:
-        """Remove crash debris (``*.tmp.npz`` / ``latest.json.tmp``) left by
-        a writer killed before its atomic publish — without the sweep a
-        leftover ``ckpt_e0003.npz.tmp.npz`` both startswith ``ckpt_e`` and
-        endswith ``.npz`` and would corrupt retention ordering."""
+        """Remove crash debris left by a writer killed before its atomic
+        publish: ``*.tmp.npz`` / ``*.manifest.json.tmp`` /
+        ``latest.json.tmp``, plus *orphan manifests* (the manifest is
+        published before its ``.npz``, so a death between the two replaces
+        leaves a manifest with no checkpoint — harmless to resume, but it
+        would read as corruption forever)."""
         for f in os.listdir(self.directory):
-            if f.endswith(".tmp.npz") or f == "latest.json.tmp":
+            if (f.endswith(".tmp.npz") or f == "latest.json.tmp"
+                    or f.endswith(".manifest.json.tmp")):
                 try:
                     os.remove(os.path.join(self.directory, f))
                 except OSError:  # lint: swallow-ok
                     pass  # concurrent cleanup / permissions: not fatal
+        for f in os.listdir(self.directory):
+            if not f.endswith(".manifest.json"):
+                continue
+            npz = f[: -len(".manifest.json")] + ".npz"
+            if not os.path.exists(os.path.join(self.directory, npz)):
+                try:
+                    os.remove(os.path.join(self.directory, f))
+                except OSError:  # lint: swallow-ok
+                    pass  # same best-effort contract as above
 
     def _path(self, epoch: int) -> str:
         return os.path.join(self.directory, f"ckpt_e{epoch:04d}.npz")
+
+    def _resolved_fingerprint(self) -> dict | None:
+        fp = self.fingerprint
+        return fp() if callable(fp) else fp
+
+    # -- clean/unclean-exit witness ------------------------------------------
+    def _dirty_path(self) -> str:
+        return os.path.join(self.directory, "dirty")
+
+    def _mark_dirty(self) -> None:
+        """A session that has written here holds the ``dirty`` marker until
+        it exits cleanly — its presence at resume time means the previous
+        writer died mid-run, which is exactly when a bit-level ``full``
+        verify is worth its read cost."""
+        if self._marked_dirty:
+            return
+        with open(self._dirty_path(), "w") as f:
+            f.write("1")
+        self._marked_dirty = True
+
+    def mark_clean(self) -> None:
+        """Clean-shutdown handshake (trainer calls this after a completed
+        run or a successful preemption checkpoint): joins the writer, then
+        drops the marker so the next resume can trust the fast verify."""
+        self.join_pending()
+        if os.path.exists(self._dirty_path()):
+            os.remove(self._dirty_path())
+        self._marked_dirty = False
+
+    def was_unclean(self) -> bool:
+        """Whether the previous session writing this directory never
+        reached its clean-shutdown handshake."""
+        return os.path.exists(self._dirty_path())
 
     def join_pending(self) -> None:
         """Wait for the in-flight writer (if any); re-raise its exception.
@@ -183,6 +472,15 @@ class Checkpointer:
         donates the param/state/opt buffers, so the device arrays
         referenced here may be invalidated the moment the next step is
         dispatched; the writer only ever sees numpy.
+
+        The snapshot must OWN its bytes: on the CPU backend
+        ``np.asarray(jax.Array)`` is a zero-copy view of the device
+        buffer, and once the next step's donation hands that buffer back
+        to XLA it is rewritten under the async writer's feet — a torn
+        ``.npz`` (and, since the integrity layer, a manifest whose CRCs
+        disagree with the published bytes, flakily failing resume-time
+        verification).  One host memcpy per leaf here buys a stable
+        snapshot on every backend.
         """
         staged: dict[str, object] = {}
         for name, tree in trees.items():
@@ -193,7 +491,13 @@ class Checkpointer:
                     staged[key] = leaf
                 else:
                     staged[key] = _to_host(leaf)  # collective on a pod
-        return {k: np.asarray(v) for k, v in staged.items()}
+        out: dict[str, np.ndarray] = {}
+        for k, v in staged.items():
+            a = np.asarray(v)
+            if a.base is not None or not a.flags.owndata:
+                a = a.copy()
+            out[k] = a
+        return out
 
     def save(self, epoch: int, iteration: int, trees: dict,
              recorder_snapshot: dict | None = None) -> SaveHandle:
@@ -213,6 +517,7 @@ class Checkpointer:
         handle = SaveHandle(self._path(epoch), epoch)
         if jax.process_index() != 0:
             return handle
+        self._mark_dirty()
         if not self.async_save:
             self._write(handle, epoch, iteration, flat, recorder_snapshot)
             return handle
@@ -233,27 +538,43 @@ class Checkpointer:
     def _write(self, handle: SaveHandle, epoch: int, iteration: int,
                flat: dict[str, np.ndarray],
                recorder_snapshot: dict | None) -> None:
-        """Serialize + atomically publish + prune (writer thread in async
-        mode, inline in sync mode — one code path, so the published bytes
-        are identical either way)."""
+        """Serialize + atomically publish + prune + scrub (writer thread in
+        async mode, inline in sync mode — one code path, so the published
+        bytes, manifest included, are identical either way)."""
         t0 = time.perf_counter()
-        if (self.fault_plan is not None
-                and self.fault_plan.fire("checkpoint", epoch) == "fail"):
+        fault = (self.fault_plan.fire("checkpoint", epoch)
+                 if self.fault_plan is not None else None)
+        if fault == "fail":
             raise OSError(f"injected checkpoint write failure "
                           f"(epoch {epoch})")
         tmp = handle.path + ".tmp.npz"
         np.savez(tmp, **flat)
+        manifest = build_manifest(epoch, iteration, flat,
+                                  self._resolved_fingerprint())
+        mpath = _manifest_path(handle.path)
+        with open(mpath + ".tmp", "w") as f:
+            json.dump(manifest, f, sort_keys=True, indent=1)
         if self._pre_publish_hook is not None:
             self._pre_publish_hook(epoch)
+        # manifest BEFORE the .npz: a published checkpoint must always have
+        # its manifest (the reverse order would make every torn publish
+        # read as a corrupt — manifest-less — checkpoint at resume)
+        os.replace(mpath + ".tmp", mpath)
         os.replace(tmp, handle.path)  # atomic publish
-        latest = os.path.join(self.directory, "latest.json")
-        with open(latest + ".tmp", "w") as f:
-            json.dump({"epoch": epoch, "iteration": iteration}, f)
-        os.replace(latest + ".tmp", latest)  # a crash must not truncate it
+        self._write_latest(epoch, iteration)
+        if fault is not None:  # truncate / bitflip / manifest_drop
+            # applied BEFORE prune/scrub, like the torn write it simulates:
+            # retention must see the corrupt newest file and protect its
+            # verified ancestors (the _prune satellite's exact scenario)
+            self._apply_corruption_fault(fault, handle.path)
         if recorder_snapshot is not None:
             from theanompi_tpu.utils.recorder import write_history_snapshot
 
             write_history_snapshot(recorder_snapshot, self.directory)
+        # scrub BEFORE retention: _prune's newest-full-verified protection
+        # can only hold if rot found this save is quarantined (and good
+        # files marked scrubbed) before the keep-n window is computed
+        self._scrub_one()
         self._prune()
         if self.telemetry is not None:
             dur = time.perf_counter() - t0
@@ -264,16 +585,179 @@ class Checkpointer:
                                  epoch=epoch)
             self.telemetry.gauge("checkpoint.write_s", dur, epoch=epoch)
 
-    def _prune(self) -> None:
-        ckpts = sorted(
+    def _apply_corruption_fault(self, action: str, path: str) -> None:
+        """The ISSUE-5 fault sites: damage the PUBLISHED files the way a
+        bit-rotted disk, torn copy, or lost manifest would — post-commit,
+        so the commit protocol itself stays honest and the recovery chain
+        is what gets exercised."""
+        print(f"faults: injected checkpoint {action} on "
+              f"{os.path.basename(path)}", file=sys.stderr, flush=True)
+        if action == "manifest_drop":
+            os.remove(_manifest_path(path))
+            return
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            if action == "truncate":
+                f.truncate(max(1, size // 2))
+            else:  # bitflip mid-file: lands in member data, not the header
+                f.seek(size // 2)
+                byte = f.read(1)
+                f.seek(size // 2)
+                f.write(bytes([byte[0] ^ 0xFF]))
+
+    # -- retention + scrub ---------------------------------------------------
+    def _ckpt_files(self) -> list[str]:
+        return sorted(
             f for f in os.listdir(self.directory)
             if f.startswith("ckpt_e") and f.endswith(".npz")
             # crash debris is not a checkpoint: ckpt_e0003.npz.tmp.npz
             # passes both tests above and would poison retention ordering
             and not f.endswith(".tmp.npz")
         )
-        for f in ckpts[: max(0, len(ckpts) - self.keep)]:
+
+    def available_epochs(self) -> list[int]:
+        """Epoch numbers present on the LOCAL filesystem, ascending."""
+        return sorted(ep for ep in map(_epoch_of, self._ckpt_files())
+                      if ep is not None)
+
+    def _fast_ok(self, fname: str) -> bool:
+        """Cached fast-verify verdict for one retained checkpoint."""
+        path = os.path.join(self.directory, fname)
+        try:
+            st = os.stat(path)
+        except OSError:
+            return False
+        key = (st.st_mtime_ns, st.st_size)
+        hit = self._verify_cache.get(fname)
+        if hit is not None and hit[0] == key:
+            return hit[1]
+        try:
+            verify_file(path, level="fast")
+            ok = True
+        except CheckpointCorruptError:
+            ok = False
+        self._verify_cache[fname] = (key, ok)
+        return ok
+
+    def _full_verified(self, fname: str) -> bool:
+        """Whether this exact file (name + mtime + size) passed a FULL
+        per-leaf hash verify via the background scrub."""
+        try:
+            st = os.stat(os.path.join(self.directory, fname))
+        except OSError:
+            return False
+        return (fname, st.st_mtime_ns, st.st_size) in self._scrubbed
+
+    def _prune(self) -> None:
+        """Retention over *verified* checkpoints only: ``keep`` counts the
+        files that pass fast verification, and the newest verifiable one is
+        always in the kept tail — a run whose last n saves rotted can no
+        longer prune its only good ancestor.  Unverifiable files are left
+        for the scrub/chain to quarantine, never silently deleted.
+
+        The newest FULL-verified checkpoint is additionally never deleted
+        until a newer one has been full-verified (the scrub runs before
+        retention for exactly this reason): fast verification cannot see a
+        data-byte bit-flip, so with a small ``keep`` the fast-ok tail alone
+        could rotate the last hash-proven checkpoint out while its newer
+        siblings are silently rotten.  Costs at most one extra retained
+        file between scrub passes."""
+        ok = [f for f in self._ckpt_files()
+              if _epoch_of(f) is not None and self._fast_ok(f)]
+        protected = next(
+            (f for f in reversed(ok) if self._full_verified(f)), None)
+        for f in ok[: max(0, len(ok) - self.keep)]:
+            if f == protected:
+                continue
             os.remove(os.path.join(self.directory, f))
+            mpath = _manifest_path(os.path.join(self.directory, f))
+            if os.path.exists(mpath):
+                os.remove(mpath)
+            self._verify_cache.pop(f, None)
+
+    def _scrub_one(self) -> None:
+        """Opportunistic background scrub (writer idle time): full-verify at
+        most ONE not-yet-scrubbed older checkpoint per save — the newest is
+        excluded (just written) — quarantining failures so rot is found
+        while there are still newer good checkpoints, not at the resume
+        that needed this file."""
+        for f in self._ckpt_files()[:-1]:
+            epoch = _epoch_of(f)
+            if epoch is None:
+                continue  # foreign file matching the glob: not ours
+            path = os.path.join(self.directory, f)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue  # pruned/quarantined concurrently
+            key = (f, st.st_mtime_ns, st.st_size)
+            if key in self._scrubbed:
+                continue
+            try:
+                verify_file(path, level="full")
+                self._scrubbed.add(key)
+            except CheckpointCorruptError as e:
+                print(f"checkpoint scrub: {e}; quarantining",
+                      file=sys.stderr, flush=True)
+                self.quarantine(epoch, reason=f"scrub: {e}")
+            return
+
+    def quarantine(self, epoch: int, reason: str) -> list[str]:
+        """Move a bad checkpoint (``.npz`` + manifest) under
+        ``<dir>/corrupt/`` — out of the chain and retention, but preserved
+        for forensics — and record the event."""
+        qdir = os.path.join(self.directory, "corrupt")
+        os.makedirs(qdir, exist_ok=True)
+        moved = []
+        for p in (self._path(epoch), _manifest_path(self._path(epoch))):
+            if not os.path.exists(p):
+                continue
+            dst = os.path.join(qdir, os.path.basename(p))
+            n = 1
+            while os.path.exists(dst):  # re-corruption of a re-saved epoch
+                dst = os.path.join(qdir, f"{os.path.basename(p)}.{n}")
+                n += 1
+            os.replace(p, dst)
+            moved.append(os.path.basename(dst))
+        self._verify_cache.pop(os.path.basename(self._path(epoch)), None)
+        self._record_event("ckpt.quarantine", epoch=epoch, reason=reason,
+                           files=moved)
+        if self.telemetry is not None:
+            self.telemetry.instant("ckpt.quarantine", epoch=epoch,
+                                   reason=reason)
+        return moved
+
+    def _record_event(self, name: str, **fields) -> None:
+        from theanompi_tpu.resilience.events import record_event
+
+        record_event(os.path.join(self.directory, "resilience.json"),
+                     name, **fields)
+
+    def _record_fallback(self, skipped: list[int], epoch: int,
+                         iteration: int, verify: str) -> None:
+        """Audit + repoint after the chain stepped past corrupt files:
+        the ``ckpt.fallback`` event lands in ``resilience.json`` and
+        telemetry, and ``latest.json`` is rewritten to the verified epoch
+        so the pointer never advertises a quarantined file."""
+        self._record_event("ckpt.fallback", bad_epochs=skipped,
+                           restored_epoch=epoch, verify=verify)
+        if self.telemetry is not None:
+            self.telemetry.instant("ckpt.fallback", bad_epochs=skipped,
+                                   restored_epoch=epoch)
+        self._write_latest(epoch, iteration)
+        print(f"checkpoint: fell back to epoch {epoch} after quarantining "
+              f"{len(skipped)} corrupt checkpoint(s) {skipped} under "
+              f"corrupt/", file=sys.stderr, flush=True)
+
+    # -- latest pointers -----------------------------------------------------
+    def _write_latest(self, epoch: int, iteration: int) -> None:
+        """Atomically (re)publish ``latest.json`` — the save's commit and
+        the chain's post-fallback repoint share one schema/one code path
+        (a crash must not truncate the pointer)."""
+        latest = os.path.join(self.directory, "latest.json")
+        with open(latest + ".tmp", "w") as f:
+            json.dump({"epoch": epoch, "iteration": iteration}, f)
+        os.replace(latest + ".tmp", latest)
 
     def _local_latest(self) -> tuple[int, int]:
         """(epoch, iteration) from the LOCAL filesystem; (-1, 0) if none."""
@@ -310,9 +794,135 @@ class Checkpointer:
     def latest_iteration(self) -> int:
         return self._synced_latest()[1]
 
-    def load(self, epoch: int, templates: dict) -> dict:
+    # -- verified load -------------------------------------------------------
+    def verify_epoch(self, epoch: int, level: str = "full") -> dict:
+        """Verify one retained epoch (file integrity + fingerprint);
+        -> its manifest."""
+        man = verify_file(self._path(epoch), level=level)
+        check_fingerprint(man, self._resolved_fingerprint(),
+                          self._path(epoch), force=self.resume_force)
+        return man
+
+    def load_latest_verified(self, templates: dict,
+                             verify: str = "fast"):
+        """The resume entry point: restore the newest *verifiable*
+        checkpoint, stepping back over corrupt ones (the recovery chain).
+
+        -> ``(epoch, iteration, restored_trees)``, or ``None`` when the
+        directory holds no checkpoints at all (a fresh start, not an
+        error).  Every checkpoint that fails verification is quarantined
+        under ``corrupt/`` and the fallback is recorded in
+        ``resilience.json`` + telemetry; if candidates existed but none
+        survived, raises :class:`CheckpointChainExhausted`.  A fingerprint
+        mismatch raises :class:`CheckpointFingerprintError` immediately —
+        older checkpoints share the topology, so walking on would only
+        quarantine good files.
+
+        ``verify='none'`` restores the pre-integrity behavior (trust
+        ``latest.json``) — the escape hatch for manifest-less legacy dirs.
+        """
+        self.join_pending()
+        if verify == "none":
+            ep, it = self._synced_latest()
+            if ep < 0:
+                return None
+            return ep, it, self.load(ep, templates, verify="none")
+        if jax.process_count() > 1:
+            return self._load_latest_verified_multihost(templates, verify)
+        epochs = self.available_epochs()
+        if not epochs:
+            return None
+        skipped: list[int] = []
+        for ep in reversed(epochs):
+            try:
+                # structural + fingerprint check up front; the full
+                # per-leaf hash (when asked for) rides the restore's own
+                # read inside load() — one decompress pass, not two.  The
+                # verified manifest is handed down so load() does not
+                # repeat the fast check (or a resume_force warning)
+                man = self.verify_epoch(ep, level="fast")
+                restored = self.load(ep, templates, verify=verify,
+                                     _verified_manifest=man)
+            except CheckpointCorruptError as e:
+                print(f"checkpoint: {e}; stepping back to the previous "
+                      f"checkpoint", file=sys.stderr, flush=True)
+                self.quarantine(ep, reason=str(e))
+                skipped.append(ep)
+                continue
+            it = int(man.get("iteration", 0))
+            if skipped:
+                self._record_fallback(skipped, ep, it, verify)
+            return ep, it, restored
+        raise CheckpointChainExhausted(
+            f"no verifiable checkpoint left in {self.directory}: all "
+            f"{len(skipped)} candidate(s) {skipped} failed verification "
+            f"and were quarantined under corrupt/")
+
+    def _load_latest_verified_multihost(self, templates: dict, verify: str):
+        """Chain selection on process 0, verdict broadcast to every process
+        (a one-sided raise inside the later array broadcast would hang the
+        pod — same discipline as ``_load_multihost``)."""
+        from jax.experimental import multihost_utils
+
+        ep, it, err = -1, 0, ""
+        if jax.process_index() == 0:
+            epochs = self.available_epochs()
+            skipped: list[int] = []
+            for cand in reversed(epochs):
+                try:
+                    # unlike the single-host chain, `full` pays a second
+                    # read at the load: a corrupt candidate must be caught
+                    # HERE, where quarantine/step-back can still act —
+                    # once the verdict is broadcast every host commits to
+                    # the collective load of this epoch
+                    man = self.verify_epoch(cand, level=verify)
+                except CheckpointFingerprintError as e:
+                    ep, err = -3, str(e)
+                    break
+                except CheckpointCorruptError as e:
+                    print(f"checkpoint: {e}; stepping back",
+                          file=sys.stderr, flush=True)
+                    self.quarantine(cand, reason=str(e))
+                    skipped.append(cand)
+                    continue
+                ep, it = cand, int(man.get("iteration", 0))
+                break
+            else:
+                if skipped:
+                    ep = -2
+            if skipped and ep >= 0:
+                self._record_fallback(skipped, ep, it, verify)
+        ep, it = (int(v) for v in multihost_utils.broadcast_one_to_all(
+            np.array([ep, it], np.int64)))
+        if ep == -3:
+            raise CheckpointFingerprintError(
+                "run fingerprint mismatch on process 0 (see its log)"
+                + (f": {err}" if err else ""))
+        if ep == -2:
+            raise CheckpointChainExhausted(
+                "no verifiable checkpoint on process 0 (all candidates "
+                "quarantined — see its log)")
+        if ep < 0:
+            return None
+        return ep, it, self.load(ep, templates, verify="none")
+
+    def load(self, epoch: int, templates: dict,
+             verify: str = "fast", _verified_manifest: dict | None = None
+             ) -> dict:
         """Restore each named pytree into the matching template's structure
-        and shardings.
+        and shardings, after verifying the file (``verify``: ``'fast'``
+        default / ``'full'`` / ``'none'``).  ``_verified_manifest``: the
+        recovery chain's seam — a manifest that already passed the fast +
+        fingerprint check this call would otherwise repeat.
+
+        Read failures surface as :class:`CheckpointCorruptError` even under
+        ``verify='none'`` — the recovery chain must be able to classify a
+        checkpoint that rots between verification and the read.
+
+        The archive is read ONCE: ``full`` runs the cheap structural/
+        fingerprint check first, then hashes the leaves as they are loaded
+        for restore — a multi-GB post-crash resume pays one decompress
+        pass, not a verify pass plus a load pass.
 
         Multi-host: process 0 reads the file and the arrays are broadcast,
         so the checkpoint dir does NOT need to be a shared filesystem (it
@@ -320,9 +930,23 @@ class Checkpointer:
         """
         self.join_pending()  # an in-flight write must publish first
         if jax.process_count() > 1:
-            return self._load_multihost(epoch, templates)
-        with np.load(self._path(epoch)) as z:
-            arrays = {k: z[k] for k in z.files}
+            return self._load_multihost(epoch, templates, verify)
+        man = _verified_manifest
+        if man is None and verify != "none":
+            man = self.verify_epoch(epoch, level="fast")
+        try:
+            with np.load(self._path(epoch)) as z:
+                arrays = {k: z[k] for k in z.files}
+        except (OSError, ValueError, zipfile.BadZipFile) as e:
+            raise CheckpointCorruptError(
+                f"{os.path.basename(self._path(epoch))}: unreadable "
+                f"checkpoint: {e}") from e
+        if verify == "full":
+            # fast verify matched the member set against the manifest, so
+            # every manifest key is present in `arrays`
+            fname = os.path.basename(self._path(epoch))
+            for key, meta in man["leaves"].items():
+                _check_leaf(fname, key, meta, arrays[key])
         out = {}
         for name, template in templates.items():
             sub = {
@@ -343,15 +967,17 @@ class Checkpointer:
             for path, leaf in jax.tree_util.tree_flatten_with_path(template)[0]
         }
 
-    def _load_multihost(self, epoch: int, templates: dict) -> dict:
-        """Process 0 reads + validates, then broadcasts to every process.
+    def _load_multihost(self, epoch: int, templates: dict,
+                        verify: str = "fast") -> dict:
+        """Process 0 verifies + reads + validates, then broadcasts.
 
-        Validation (missing leaves, shape mismatches) and dtype coercion
-        happen on process 0 BEFORE any collective: a one-sided raise inside
-        the broadcast would leave the other processes hung in a collective
-        that never completes, and mismatched per-process avals would fail
-        opaquely inside Gloo/XLA instead of with the diagnostic.  The
-        verdict is broadcast as a status flag so every process raises.
+        Validation (verification, missing leaves, shape mismatches) and
+        dtype coercion happen on process 0 BEFORE any collective: a
+        one-sided raise inside the broadcast would leave the other
+        processes hung in a collective that never completes, and mismatched
+        per-process avals would fail opaquely inside Gloo/XLA instead of
+        with the diagnostic.  The verdict is broadcast as a status flag so
+        every process raises.
         """
         from jax.experimental import multihost_utils
 
@@ -359,8 +985,14 @@ class Checkpointer:
         err = ""
         if jax.process_index() == 0:
             try:
+                man = (self.verify_epoch(epoch, level="fast")
+                       if verify != "none" else None)
                 with np.load(self._path(epoch)) as z:
                     arrays = {k: z[k] for k in z.files}
+                if verify == "full":  # hash the single read, like load()
+                    fname = os.path.basename(self._path(epoch))
+                    for key, meta in man["leaves"].items():
+                        _check_leaf(fname, key, meta, arrays[key])
                 for name, template in templates.items():
                     sub = {}
                     tleaves = jax.tree_util.tree_flatten_with_path(template)[0]
@@ -380,7 +1012,8 @@ class Checkpointer:
                         sub[key] = arr.astype(
                             getattr(leaf, "dtype", np.float32))
                     subs[name] = sub
-            except (OSError, KeyError, ValueError) as e:
+            except (OSError, KeyError, ValueError, CheckpointError,
+                    zipfile.BadZipFile) as e:
                 err = f"{type(e).__name__}: {e}"
                 print(f"checkpoint restore failed on process 0: {err}",
                       flush=True)
@@ -397,3 +1030,74 @@ class Checkpointer:
             sub = multihost_utils.broadcast_one_to_all(sub)
             out[name] = _restore_into(template, sub)
         return out
+
+
+# -- scrubber CLI ------------------------------------------------------------
+
+def main(argv: list[str] | None = None) -> int:
+    """``python -m theanompi_tpu.utils.checkpoint --verify <dir>``:
+    verify every retained checkpoint against its manifest (full per-leaf
+    hash by default; ``--fast`` for the cheap structural check) and report
+    one line per file.  Exit 0 when everything verifies, ``EXIT_CKPT=77``
+    when anything fails.  ``--quarantine`` additionally moves failed pairs
+    under ``<dir>/corrupt/`` (the default is a read-only report)."""
+    import argparse
+
+    from theanompi_tpu.resilience.codes import EXIT_CKPT
+
+    p = argparse.ArgumentParser(
+        prog="python -m theanompi_tpu.utils.checkpoint",
+        description="Checkpoint integrity scrubber: verify every retained "
+        "checkpoint in a directory against its manifest.")
+    p.add_argument("--verify", metavar="DIR", required=True,
+                   help="checkpoint directory to scrub")
+    p.add_argument("--fast", action="store_true",
+                   help="structural check only (manifest + member set); "
+                   "skip the per-leaf hash read")
+    p.add_argument("--quarantine", action="store_true",
+                   help="move failed checkpoints under DIR/corrupt/ "
+                   "(default: report only)")
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.verify):
+        p.error(f"not a directory: {args.verify}")
+    # same membership rule as retention/scrub/chain: foreign files that
+    # happen to match the glob (ckpt_e0003.bak.npz) are not checkpoints —
+    # reporting them CORRUPT would flip the exit code to 77 for a
+    # perfectly healthy chain
+    files = sorted(
+        f for f in os.listdir(args.verify)
+        if f.startswith("ckpt_e") and f.endswith(".npz")
+        and not f.endswith(".tmp.npz") and _epoch_of(f) is not None)
+    if not files:
+        print(f"{args.verify}: no checkpoints")
+        return 0
+    level = "fast" if args.fast else "full"
+    bad = 0
+    # sweep_debris=False: this CLI may point at a directory a LIVE
+    # supervised run is writing — the init-time debris sweep would delete
+    # the writer's in-flight .tmp files out from under its atomic publish
+    quarantiner = (Checkpointer(args.verify, sweep_debris=False)
+                   if args.quarantine else None)
+    for f in files:
+        path = os.path.join(args.verify, f)
+        try:
+            man = verify_file(path, level=level)
+        except CheckpointCorruptError as e:
+            bad += 1
+            print(f"{f}: CORRUPT — {e}")
+            if quarantiner is not None:
+                moved = quarantiner.quarantine(
+                    _epoch_of(f), reason=f"scrubber CLI: {e}")
+                print(f"{f}: quarantined -> corrupt/ ({', '.join(moved)})")
+            continue
+        mib = sum(m["nbytes"] for m in man["leaves"].values()) / 2**20
+        print(f"{f}: OK ({len(man['leaves'])} leaves, {mib:.1f} MiB, "
+              f"epoch {man['epoch']}, iteration {man['iteration']}, "
+              f"{level} verify)")
+    print(f"{len(files) - bad}/{len(files)} checkpoints verifiable "
+          f"({level})")
+    return EXIT_CKPT if bad else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
