@@ -325,6 +325,55 @@ def _maybe_telemetry():
     return Telemetry(tel_dir)
 
 
+def run_serve_bench() -> dict:
+    """BENCH_SERVE mode (ISSUE 6): synthetic open-loop serving through the
+    continuous-batching engine; -> the SERVE.json report dict.
+
+    Knobs (all optional): BENCH_SERVE_REQUESTS / _PROMPT / _NEW / _BATCH /
+    _BLOCK_SIZE / _BLOCKS / _RATE (req/s, 0 = burst) / _QUANT (int8
+    weights) / _CKPT (verified checkpoint dir) / _SET (semicolon-separated
+    model k=v pairs layered over the bench transformer geometry).
+    """
+    import argparse
+
+    from theanompi_tpu.serving import cli as serve_cli
+
+    env = os.environ.get
+    platform = jax.devices()[0].platform
+    dim = int(env("BENCH_DIM", "512" if platform == "tpu" else "64"))
+    model_set = [
+        f"dim={dim}", f"heads={max(1, dim // 64)}",
+        f"n_layers={env('BENCH_LAYERS', '8' if platform == 'tpu' else '2')}",
+        f"seq_len={env('BENCH_SEQ', '2048' if platform == 'tpu' else '64')}",
+        f"vocab={env('BENCH_VOCAB', '32768' if platform == 'tpu' else '256')}",
+        "dropout=0.0", "precision=" + ("bf16" if platform == "tpu"
+                                       else "fp32"),
+    ]
+    for pair in (env("BENCH_SERVE_SET", "") or "").split(";"):
+        if pair.strip():
+            model_set.append(pair.strip())
+    args = argparse.Namespace(
+        modelfile="theanompi_tpu.models.transformer_lm",
+        modelclass="TransformerLM", model_set=model_set,
+        checkpoint_dir=env("BENCH_SERVE_CKPT") or None,
+        serve_verify="fast", serve_force=False,
+        max_batch=int(env("BENCH_SERVE_BATCH", "8")),
+        block_size=int(env("BENCH_SERVE_BLOCK_SIZE", "16")),
+        num_blocks=(int(env("BENCH_SERVE_BLOCKS"))
+                    if env("BENCH_SERVE_BLOCKS") else None),
+        quantize_int8=bool(int(env("BENCH_SERVE_QUANT", "0"))),
+        top_k=0,
+        requests=int(env("BENCH_SERVE_REQUESTS", "16")),
+        prompt_len=int(env("BENCH_SERVE_PROMPT", "16")),
+        max_new_tokens=int(env("BENCH_SERVE_NEW", "32")),
+        arrival_rate=float(env("BENCH_SERVE_RATE", "0")),
+        temperature=0.0, seed=int(env("BENCH_SEED", "0")),
+        telemetry_dir=env("BENCH_TELEMETRY_DIR") or None,
+        out=None, quiet=True,
+    )
+    return serve_cli.serve(args)
+
+
 def _measure():
     """One full measurement pass: primary line + transformer side artifact."""
     if os.environ.get("BENCH_COMPILE_CACHE"):
@@ -334,6 +383,20 @@ def _measure():
         from theanompi_tpu.parallel.mesh import setup_compile_cache
 
         setup_compile_cache(os.environ["BENCH_COMPILE_CACHE"])
+    if os.environ.get("BENCH_SERVE"):
+        # serving bench (ISSUE 6): one JSON line + the SERVE.json artifact
+        # (atomic publish, same run_id staleness contract as the side-bench)
+        run_id = (time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+                  + f"-p{os.getpid()}")
+        out = run_serve_bench()
+        out["run_id"] = run_id
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "SERVE.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump(out, f, indent=1)
+        os.replace(path + ".tmp", path)
+        print(json.dumps(out))
+        return
     model_name = os.environ.get("BENCH_MODEL", "resnet50")
     # run id stamped onto every artifact this process emits: a stale side
     # artifact surviving a failed later run is detectable by its id not
@@ -395,6 +458,54 @@ def _measure():
             os.remove(path + ".tmp")
         except OSError:  # no leftover, or something unremovable — not worth
             pass         # failing the primary line over
+
+
+def _names_backend_init(msg_low: str) -> bool:
+    """Does this error message describe backend initialization at all?"""
+    return ("unknown backend" in msg_low
+            or "unable to initialize backend" in msg_low
+            or "failed to initialize" in msg_low
+            or ("platform" in msg_low and "present" in msg_low))
+
+
+def backend_hint(e: BaseException) -> str | None:
+    """The one-line actionable message for a backend-init failure: names
+    the backend and the JAX_PLATFORMS remediation (ISSUE 6 satellite — the
+    BENCH_r04/r05 failure mode previously surfaced as a raw jax traceback).
+    None when the error is not backend-init shaped."""
+    msg = str(e)
+    low = msg.lower()
+    if not _names_backend_init(low):
+        return None
+    import re
+
+    m = re.search(r"backend:?\s+'?([a-z0-9_]+)'?", low)
+    name = m.group(1) if m else (os.environ.get("JAX_PLATFORMS")
+                                 or os.environ.get("BENCH_PLATFORM")
+                                 or "requested")
+    first = " ".join(msg.split())[:200]
+    return (f"bench: backend {name!r} unavailable ({first}) — set "
+            f"JAX_PLATFORMS (or BENCH_PLATFORM) to an available backend, "
+            f"e.g. JAX_PLATFORMS=cpu")
+
+
+def backend_unavailable_error(e: BaseException) -> str | None:
+    """The FAIL-FAST classifier: the hint, but only for deterministic
+    absence — "Unknown backend" / "no ... platforms ... present", or an
+    init failure WITHOUT transient markers (UNAVAILABLE / DEADLINE /
+    connection), which retrying cannot fix.  A flapped tunnel ("Unable to
+    initialize backend 'tpu': UNAVAILABLE ...") returns None and keeps the
+    bounded retry path; the hint still lands in the final give-up line.
+    Unit-tested against the canned phrasings in ``tests/test_bench_retry.py``.
+    """
+    low = str(e).lower()
+    if not _names_backend_init(low):
+        return None
+    deterministic = ("unknown backend" in low
+                     or ("platform" in low and "present" in low))
+    if not deterministic and _transient(e):
+        return None
+    return backend_hint(e)
 
 
 def _transient(e: BaseException) -> bool:
@@ -482,16 +593,25 @@ def main():
         _acquire_backend(float(os.environ.get("BENCH_INIT_TIMEOUT", "300")))
         _measure()
     except Exception as e:
+        # a backend that is deterministically ABSENT (vs a flapped tunnel)
+        # cannot be retried into existence: fail fast with the one-line
+        # actionable error instead of 5 x 60 s + a raw jax traceback
+        unavailable = backend_unavailable_error(e)
+        if unavailable:
+            # SystemExit's string arg is printed to stderr by the
+            # interpreter — no explicit print, or the line doubles
+            raise SystemExit(unavailable)
         line = f"attempt {attempt}/{retries}: {type(e).__name__}: {str(e)[:300]}"
         log = os.environ.get("BENCH_ATTEMPT_LOG", "")
         log = (log + " | " if log else "") + line
         print(f"bench: {line}", file=sys.stderr)
         if attempt >= retries or not _transient(e):
             traceback.print_exc()
+            hint = backend_hint(e)
             raise SystemExit(
                 f"bench: giving up after {attempt} attempts"
                 f"{'' if _transient(e) else ' (non-transient error)'};"
-                f" log: {log}")
+                f" log: {log}" + (f"\n{hint}" if hint else ""))
         os.environ["BENCH_ATTEMPT"] = str(attempt + 1)
         os.environ["BENCH_ATTEMPT_LOG"] = log
         time.sleep(backoff)

@@ -67,6 +67,59 @@ def test_retry_gives_up_with_attempt_log_in_error_tail():
     assert p.stdout.strip() == ""  # no half-measured JSON line
 
 
+def test_backend_unavailable_message_is_one_actionable_line():
+    """ISSUE 6 satellite: the BENCH_r04/r05 failure mode (requested TPU
+    backend absent) must classify as deterministic and produce the
+    one-line error naming the backend and JAX_PLATFORMS — not a raw jax
+    traceback.  Canned phrasings cover both jax spellings."""
+    import bench
+
+    # the r04/r05 spelling: platform requested but not present
+    e = RuntimeError("Unknown backend: 'tpu' requested, but no platforms "
+                     "that are instances of tpu are present.")
+    msg = bench.backend_unavailable_error(e)
+    assert msg is not None and "\n" not in msg
+    assert "'tpu'" in msg and "JAX_PLATFORMS" in msg
+    assert "JAX_PLATFORMS=cpu" in msg  # the actionable remediation
+
+    # the config-level spelling (BENCH_PLATFORM typo / missing plugin)
+    e2 = RuntimeError("Unable to initialize backend 'nope': Backend 'nope' "
+                      "is not in the list of known backends: ['cpu'].")
+    msg2 = bench.backend_unavailable_error(e2)
+    assert msg2 is not None and "'nope'" in msg2 and "JAX_PLATFORMS" in msg2
+
+
+def test_backend_transient_init_failure_keeps_retry_path():
+    """A flapped tunnel ("UNAVAILABLE") is NOT deterministic absence: the
+    fail-fast classifier must decline it so the bounded re-exec retry
+    still runs — but the hint lands in the final give-up line."""
+    import bench
+
+    e = RuntimeError("Unable to initialize backend 'tpu': UNAVAILABLE: "
+                     "connection attempt failed")
+    assert bench.backend_unavailable_error(e) is None
+    hint = bench.backend_hint(e)
+    assert hint is not None and "'tpu'" in hint and "JAX_PLATFORMS" in hint
+    # non-backend errors classify as neither
+    assert bench.backend_unavailable_error(ValueError("bad BENCH_BS")) is None
+    assert bench.backend_hint(ValueError("bad BENCH_BS")) is None
+
+
+def test_backend_unavailable_fails_fast_end_to_end():
+    """The subprocess contract: an absent backend exits once with the
+    one-line error — no 5 x 60 s retry burn, no raw jax traceback."""
+    p = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True, timeout=120,
+        env=_env(BENCH_PLATFORM="nope", BENCH_INIT_RETRIES=5),
+    )
+    assert p.returncode != 0
+    assert "backend 'nope' unavailable" in p.stderr
+    assert "JAX_PLATFORMS" in p.stderr
+    assert "Traceback" not in p.stderr
+    assert "attempt 1/" not in p.stderr  # no retries were burned
+    assert p.stdout.strip() == ""
+
+
 class _FakeRecorder:
     def __init__(self):
         import collections

@@ -21,8 +21,9 @@ Escapes, kept visible at the call site:
 - the allowlist below for the two documented correlated-failure teardown
   sites (``BaseTrainer.run``'s checkpoint-writer join and ``Rule.wait``'s
   telemetry finalize: a secondary error there must not mask the primary
-  exception already unwinding) plus ``launcher.main``, whose whole job is
-  converting exceptions into the exit-code contract.
+  exception already unwinding) plus ``launcher.main`` and the serving
+  CLI's ``main``, whose whole job is converting exceptions into the
+  shared exit-code contract.
 
 The companion ``faultinject`` pytest marker is registered in
 ``pyproject.toml`` so the fault-plan tests stay in tier-1 while remaining
@@ -41,6 +42,7 @@ ALLOWLIST = {
     ("theanompi_tpu/parallel/trainer.py", "run"),    # teardown join
     ("theanompi_tpu/parallel/trainer.py", "wait"),   # telemetry finalize
     ("theanompi_tpu/launcher.py", "main"),           # exit-code contract
+    ("theanompi_tpu/serving/cli.py", "main"),        # tmserve exit-code contract
 }
 
 BROAD = {"Exception", "BaseException"}
@@ -152,6 +154,64 @@ NP_LOAD_ALLOWED_PREFIXES = (
     "theanompi_tpu/utils/recorder.py",     # history .npy snapshots
     "theanompi_tpu/models/data/",          # dataset shard reads
 )
+
+
+#: training-side modules the serving package must NEVER import (ISSUE 6):
+#: serving is a read-only consumer — a gradient, optimizer, exchanger or
+#: supervisor import there means training machinery leaked into the
+#: inference path (and with it, write access to training state)
+SERVING_FORBIDDEN_IMPORTS = (
+    "theanompi_tpu.parallel.trainer",
+    "theanompi_tpu.parallel.bsp",
+    "theanompi_tpu.parallel.easgd",
+    "theanompi_tpu.parallel.gosgd",
+    "theanompi_tpu.parallel.exchanger",
+    "theanompi_tpu.parallel.pipeline",
+    "theanompi_tpu.ops.opt",
+    "theanompi_tpu.resilience.supervisor",
+    "theanompi_tpu.resilience.sentinel",
+    "theanompi_tpu.resilience.watchdog",
+    "theanompi_tpu.resilience.faults",
+)
+
+
+def _imported_modules(tree: ast.AST):
+    """Every module name an ``import`` / ``from ... import`` touches."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                yield node.lineno, alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            yield node.lineno, node.module
+            # `from pkg import sub` can also bind submodules
+            for alias in node.names:
+                yield node.lineno, f"{node.module}.{alias.name}"
+
+
+def test_serving_never_imports_training_paths():
+    """The serving package is a consumer: no trainer, exchanger, optimizer,
+    or supervisor imports anywhere under ``theanompi_tpu/serving/`` —
+    its int8 quantization reuses ``ops/quant.py`` (the shared primitive
+    extracted from the exchanger), never the exchanger itself."""
+    offenders = []
+    for path in sorted((REPO / "theanompi_tpu" / "serving").rglob("*.py")):
+        rel = str(path.relative_to(REPO))
+        tree = ast.parse(path.read_text())
+        for lineno, mod in _imported_modules(tree):
+            if any(mod == bad or mod.startswith(bad + ".")
+                   for bad in SERVING_FORBIDDEN_IMPORTS):
+                offenders.append(f"{rel}:{lineno}: imports {mod}")
+    assert not offenders, (
+        "serving/ imports training-side machinery — the inference path "
+        "must stay a read-only consumer:\n" + "\n".join(offenders))
+
+
+def test_serving_has_no_np_load_allowance():
+    """Serving reads checkpoint bytes ONLY through the verified loader:
+    no ``serving/`` prefix may appear in the np.load allowlist (and the
+    package-wide np.load lint below therefore covers it)."""
+    assert not any(p.startswith("theanompi_tpu/serving")
+                   for p in NP_LOAD_ALLOWED_PREFIXES)
 
 
 def test_checkpoint_npz_loads_confined_to_verified_loader():

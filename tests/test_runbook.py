@@ -148,6 +148,59 @@ def test_runbook_exchange_bench_command(tmp_path):
     assert row["step_ms"] > 0
 
 
+def test_runbook_serve_command(tmp_path, capsys):
+    """RUNBOOK step 6 (ISSUE 6): the exact `tmserve` invocation — verified
+    read-only checkpoint load (matching --set config), continuous-batching
+    engine, --quantize-int8, --telemetry-dir, SERVE.json artifact with the
+    fields the runbook's headroom procedure reads."""
+    import jax
+    import numpy as np
+
+    from theanompi_tpu.launcher import _parse_kv
+    from theanompi_tpu.models.transformer_lm import TransformerLM
+    from theanompi_tpu.serving import cli as serve_cli
+    from theanompi_tpu.utils.checkpoint import Checkpointer, model_fingerprint
+
+    tiny = ["dim=32", "heads=2", "n_layers=1", "seq_len=32", "vocab=61",
+            "dropout=0.0", "precision=fp32", "n_train=64", "n_val=32"]
+    # a training-writer checkpoint with the FULL run fingerprint — the
+    # serving load must match on the model-identity subset only
+    model = TransformerLM(_parse_kv(tiny))
+    params, _ = model.init_params(jax.random.PRNGKey(0))
+    ckpt = str(tmp_path / "ckpt")
+    writer = Checkpointer(ckpt, fingerprint={
+        "mesh": {"data": 8}, "exchange": "psum_bf16_bucket", "n_subb": 1,
+        **model_fingerprint(model)})
+    writer.save(0, 5, {"params": jax.tree.map(np.asarray, params)})
+    writer.mark_clean()
+
+    out = str(tmp_path / "SERVE.json")
+    tel = str(tmp_path / "telemetry-serve")
+    rc = serve_cli.main([
+        "--modelclass", "TransformerLM",
+        *[a for s in tiny for a in ("--set", s)],
+        "--checkpoint-dir", ckpt, "--requests", "4", "--arrival-rate", "50",
+        "--prompt-len", "4", "--max-new-tokens", "4",
+        "--max-batch", "2", "--block-size", "4", "--quantize-int8",
+        "--telemetry-dir", tel, "--out", out, "--quiet",
+    ])
+    assert rc == 0
+    art = json.load(open(out))
+    # the fields step 6's headroom procedure reads
+    assert art["metric"] == "serve_tokens_per_sec" and art["value"] > 0
+    assert art["requests"] == 4 and art["checkpoint_epoch"] == 0
+    assert "preemptions" in art and art["quantized_int8"]
+    for h in ("ttft_ms", "token_ms"):
+        assert "p50" in art[h] and "p99" in art[h]
+    # one-JSON-line stdout (bench contract) + the Perfetto trace
+    line = [l for l in capsys.readouterr().out.splitlines()
+            if l.startswith("{")][-1]
+    assert json.loads(line)["metric"] == "serve_tokens_per_sec"
+    trace = json.load(open(os.path.join(tel, "trace.json")))
+    names = {e["name"] for e in trace["traceEvents"] if e.get("ph") == "X"}
+    assert "serve.prefill" in names and "serve.decode" in names
+
+
 def test_runbook_checkpoint_scrubber_command(tmp_path, capsys):
     """The RUNBOOK's checkpoint-hygiene step (ISSUE 5): the exact
     `python -m theanompi_tpu.utils.checkpoint --verify DIR` scrubber CLI

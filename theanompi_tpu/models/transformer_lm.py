@@ -102,6 +102,48 @@ class _Block(L.Layer):
         h, _ = drop.apply({}, {}, h, train=train, rng=rngs[1])
         return x + h, ffn_state
 
+    # -- serving path (ISSUE 6) ----------------------------------------------
+    # Both steps reuse the training block's exact sub-layers (same params,
+    # same LN/residual wiring, dropout off); only the attention context
+    # comes from the duck-typed paged KV cache the serving engine passes in
+    # (:class:`theanompi_tpu.serving.kv_cache.PagedKVCache` — models never
+    # import serving, so the dependency edge stays serving -> models).
+
+    def prefill_step(self, params, x, cache, layer_idx, table_row):
+        """Full-prompt forward of one block: writes this layer's K/V into
+        the cache, attends causally *within* the prompt via the training
+        attention dispatch (pallas flash on TPU when the shape gate admits).
+        ``x`` ``[1, P_pad, D]`` -> (y, cache')."""
+        subs = dict(self._subs())
+        attn = subs["attn"]
+        h, _ = subs["ln1"].apply(params["ln1"], {}, x)
+        q, k, v = attn.project_qkv(params["attn"], h)
+        cache = cache.write_prefill(layer_idx, k, v, table_row)
+        ctx = attn.attend(q, k, v)
+        h = attn.project_out(
+            params["attn"], ctx.reshape(x.shape[0], x.shape[1], -1))
+        x = x + h
+        h, _ = subs["ln2"].apply(params["ln2"], {}, x)
+        h, _ = self._apply_ffn(subs, params, {}, h, False)
+        return x + h, cache
+
+    def decode_step(self, params, x, cache, layer_idx, positions):
+        """One-token incremental forward of one block: appends this layer's
+        K/V at ``positions`` and attends over the cached context.
+        ``x`` ``[B, 1, D]``, ``positions`` ``[B]`` -> (y, cache')."""
+        subs = dict(self._subs())
+        attn = subs["attn"]
+        h, _ = subs["ln1"].apply(params["ln1"], {}, x)
+        q, k, v = attn.project_qkv(params["attn"], h)
+        cache = cache.write_decode(layer_idx, k[:, 0], v[:, 0], positions)
+        ctx = cache.attend_decode(layer_idx, q[:, 0], positions)
+        h = attn.project_out(
+            params["attn"], ctx.reshape(x.shape[0], 1, -1))
+        x = x + h
+        h, _ = subs["ln2"].apply(params["ln2"], {}, x)
+        h, _ = self._apply_ffn(subs, params, {}, h, False)
+        return x + h, cache
+
 
 @dataclasses.dataclass(frozen=True)
 class _MoEBlock(_Block):
@@ -205,6 +247,83 @@ class TransformerLM(SupervisedModel):
         if mode == "auto":
             return self.data.vocab >= 8192
         return bool(mode)
+
+    # -- serving path (ISSUE 6) ----------------------------------------------
+    def _serving_layers(self):
+        """(name, layer) pairs of the trunk Sequential, in order — the
+        serving engine drives the SAME param tree the trainer checkpoints,
+        so a verified restore plugs straight in."""
+        if self.net is None or not hasattr(self.net, "layers"):
+            raise NotImplementedError(
+                f"{type(self).__name__} has no serving decode path (the "
+                f"pipeline variant stacks its blocks for GPipe; export the "
+                f"checkpoint to the plain TransformerLM layout to serve it)")
+        return [(f"{i:02d}_{layer.name}", layer)
+                for i, layer in enumerate(self.net.layers)]
+
+    def _head_logits(self, cp, h):
+        w = cp["head"]["w"].astype(h.dtype)
+        y = h @ w
+        if "b" in cp["head"]:
+            y = y + cp["head"]["b"].astype(h.dtype)
+        return y.astype(jnp.float32)
+
+    def apply_logits(self, params, state, tokens):
+        """Full-sequence forward to per-position logits ``[B, T, V]`` —
+        the batched reference the serving parity/smoke tests compare
+        incremental decode against (and the plain eval entry point the
+        fused loss path deliberately avoids materializing in training)."""
+        cp = self.precision.cast_to_compute(params)
+        h, _ = self.apply_trunk(cp, state, tokens, train=False, rng=None)
+        return self._head_logits(cp, h)
+
+    def apply_prefill(self, params, state, kv_cache, table_row, tokens):
+        """Prompt prefill for ONE sequence: ``tokens`` ``[1, P_pad]`` (end-
+        padded to a whole number of cache blocks — causal masking keeps the
+        padding out of every real position's context), ``table_row`` the
+        sequence's block table.  -> (logits ``[1, P_pad, V]`` fp32, cache').
+        """
+        del state
+        cp = self.precision.cast_to_compute(params)
+        x, li = None, 0
+        for name, layer in self._serving_layers():
+            p = cp.get(name, {})
+            if isinstance(layer, L.Embedding):
+                x = jnp.take(p["w"], tokens, axis=0)
+            elif isinstance(layer, PositionEmbedding):
+                x, _ = layer.apply(p, {}, x)
+            elif isinstance(layer, _Block):
+                x, kv_cache = layer.prefill_step(p, x, kv_cache, li,
+                                                 table_row)
+                li += 1
+            else:
+                x, _ = layer.apply(p, {}, x)
+        return self._head_logits(cp, x), kv_cache
+
+    def apply_decode(self, params, state, kv_cache, positions, tokens):
+        """One incremental decode step for a fixed batch of sequences:
+        ``tokens`` ``[B]`` (the token AT ``positions``), ``positions``
+        ``[B]`` 0-based.  Appends each layer's K/V to the paged cache and
+        attends over the cached context.  -> (logits ``[B, V]`` fp32,
+        cache').  Inactive batch slots ride along with their block tables
+        pointed at the cache's reserved null block."""
+        del state
+        cp = self.precision.cast_to_compute(params)
+        x, li = None, 0
+        for name, layer in self._serving_layers():
+            p = cp.get(name, {})
+            if isinstance(layer, L.Embedding):
+                x = jnp.take(p["w"], tokens, axis=0)[:, None, :]
+            elif isinstance(layer, PositionEmbedding):
+                pos = jnp.take(p["pos"], positions, axis=0).astype(x.dtype)
+                x = x + pos[:, None, :]
+            elif isinstance(layer, _Block):
+                x, kv_cache = layer.decode_step(p, x, kv_cache, li,
+                                                positions)
+                li += 1
+            else:
+                x, _ = layer.apply(p, {}, x)
+        return self._head_logits(cp, x[:, 0, :]), kv_cache
 
     # -- sharding ------------------------------------------------------------
     def _head_specs(self, params):

@@ -101,16 +101,19 @@ class MultiHeadAttention(L.Layer):
             params[name] = p
         return params, {}, tuple(in_shape)
 
-    def apply(self, params, state, x, *, train=False, rng=None):
-        subs = dict(self._subs())
+    def project_qkv(self, params, x):
+        """Fused QKV projection: ``[B, T, D] -> 3 x [B, T, h_local, Dh]``.
+
+        The params stay three separate leaves (TP rules, checkpoints, tests
+        address them unchanged) but the weights concatenate at apply time so
+        x is read once, not three times — under TP each leaf is the local
+        ``[D, D/tp]`` slice and the concat is the local slice of the fused
+        projection (Megatron's layout).  Shared between training ``apply``
+        and the serving prefill/decode paths (ISSUE 6), which write the
+        k/v halves into the paged KV cache.
+        """
         b, t, _ = x.shape
         head_dim = self.dim // self.heads
-        x = identity_fwd_psum_bwd(x)  # once for all three projections
-        # one fused QKV matmul: the params stay three separate leaves (TP
-        # rules, checkpoints, tests address them unchanged) but the weights
-        # concatenate at apply time so x is read once, not three times —
-        # under TP each leaf is the local [D, D/tp] slice and the concat is
-        # the local slice of the fused projection (Megatron's layout)
         w_qkv = jnp.concatenate(
             [params["q"]["w"], params["k"]["w"], params["v"]["w"]], axis=1
         ).astype(x.dtype)
@@ -128,18 +131,35 @@ class MultiHeadAttention(L.Layer):
         q = q.reshape(b, t, h_local, head_dim)
         k = k.reshape(b, t, h_local, head_dim)
         v = v.reshape(b, t, h_local, head_dim)
-        if axis_bound(SEQ_AXIS) and jax.lax.axis_size(SEQ_AXIS) > 1:
-            out = ring_attention(q, k, v, causal=self.causal)
-        else:
-            from theanompi_tpu.ops.pallas_attention import flash_attention
+        return q, k, v
 
-            if resolve_attn_impl(self.impl, t, head_dim) == "pallas":
-                out = flash_attention(q, k, v, causal=self.causal)
-            else:
-                out = blockwise_attention(q, k, v, causal=self.causal)
-        out = out.reshape(b, t, h_local * head_dim)
+    def attend(self, q, k, v):
+        """The attention core over ``[B, T, H, Dh]``: ring under a sharded
+        seq axis, else the resolved pallas/blockwise path.  The serving
+        prefill reuses exactly this dispatch (so a TPU prefill rides the
+        flash kernels whenever the shape gate admits them)."""
+        t, head_dim = q.shape[1], q.shape[3]
+        if axis_bound(SEQ_AXIS) and jax.lax.axis_size(SEQ_AXIS) > 1:
+            return ring_attention(q, k, v, causal=self.causal)
+        from theanompi_tpu.ops.pallas_attention import flash_attention
+
+        if resolve_attn_impl(self.impl, t, head_dim) == "pallas":
+            return flash_attention(q, k, v, causal=self.causal)
+        return blockwise_attention(q, k, v, causal=self.causal)
+
+    def project_out(self, params, out):
+        """Output projection over the flattened head dim ``[B, T, h*Dh]``."""
+        subs = dict(self._subs())
         y, _ = subs["o"].apply(params["o"], {}, out)
-        return y, state
+        return y
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        b, t, _ = x.shape
+        x = identity_fwd_psum_bwd(x)  # once for all three projections
+        q, k, v = self.project_qkv(params, x)
+        out = self.attend(q, k, v)
+        out = out.reshape(b, t, q.shape[2] * q.shape[3])
+        return self.project_out(params, out), state
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,59 @@
+"""Shared int8 quantization primitives: per-chunk scale + stochastic rounding.
+
+Extracted from ``parallel/exchanger.py`` (ISSUE 6) so the serving path can
+reuse the exact wire format of the ``ring_int8`` exchange strategy without
+importing the training-side exchanger (the serving lint forbids that edge):
+
+- **per-chunk fp32 scale**: one ``max|x| / 127`` scale per fixed-size chunk
+  of the flattened tensor — coarse enough to be free, fine enough that a
+  single outlier only poisons its own chunk;
+- **stochastic rounding**: ``floor(y + U[0,1))`` is an unbiased rounding of
+  ``y``, so quantization error is zero-mean (for gradients that keeps the
+  expected update exact; for weights it keeps the expected dequantized
+  weight exact under the explicit PRNG key, making quantization a seeded,
+  reproducible transform).
+
+The exchanger's ring schedule quantizes per ring hop with these same
+helpers; serving quantizes matmul weights once at load
+(:mod:`theanompi_tpu.serving.quant`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize_chunk(x: jax.Array, key: jax.Array):
+    """-> (int8 payload, fp32 scale) with per-chunk scale + stochastic
+    rounding: ``E[dequantize(q)] == x`` because ``floor(y + U[0,1))`` is an
+    unbiased rounding of ``y``.  The scale guard keeps all-zero chunks
+    finite (0/eps -> exactly 0)."""
+    scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-30) / 127.0
+    y = x.astype(jnp.float32) / scale
+    u = jax.random.uniform(key, y.shape)
+    q = jnp.clip(jnp.floor(y + u), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def quantize_chunked(x: jax.Array, key: jax.Array, chunk_elems: int):
+    """Flatten ``x``, zero-pad to a multiple of ``chunk_elems``, quantize
+    each chunk with its own scale; -> (q ``[n_chunks, chunk_elems]`` int8,
+    scales ``[n_chunks]`` fp32).  ``vmap`` over chunks so every chunk gets
+    an independent rounding stream from one key."""
+    flat = x.reshape(-1).astype(jnp.float32)
+    pad = (-flat.size) % chunk_elems
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    chunks = flat.reshape(-1, chunk_elems)
+    keys = jax.random.split(key, chunks.shape[0])
+    return jax.vmap(quantize_chunk)(chunks, keys)
+
+
+def dequantize_chunked(q: jax.Array, scales: jax.Array, shape, dtype):
+    """Inverse of :func:`quantize_chunked`: drop the padding tail and
+    restore ``shape``/``dtype``."""
+    import numpy as np
+
+    flat = (q.astype(jnp.float32) * scales[:, None]).reshape(-1)
+    return flat[: int(np.prod(shape, dtype=np.int64))].reshape(shape).astype(dtype)

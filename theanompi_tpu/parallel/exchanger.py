@@ -234,17 +234,10 @@ def _ring_bf16_mean(x, axis_name, axis_size):
 
 
 # -- int8 quantized ring (the modern ``asa16``) ------------------------------
-
-def _quantize_chunk(x: jax.Array, key: jax.Array):
-    """-> (int8 payload, fp32 scale) with per-chunk scale + stochastic
-    rounding: ``E[dequantize(q)] == x`` because ``floor(y + U[0,1))`` is an
-    unbiased rounding of ``y``.  The scale guard keeps all-zero chunks
-    finite (0/eps -> exactly 0)."""
-    scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-30) / 127.0
-    y = x.astype(jnp.float32) / scale
-    u = jax.random.uniform(key, y.shape)
-    q = jnp.clip(jnp.floor(y + u), -127, 127).astype(jnp.int8)
-    return q, scale
+# The per-chunk-scale + stochastic-rounding primitive moved to
+# ``ops/quant.py`` (ISSUE 6) so the serving path's weight quantization can
+# share the exact wire format without importing this training-side module.
+from theanompi_tpu.ops.quant import quantize_chunk as _quantize_chunk  # noqa: E402
 
 
 def _ring_allreduce_int8(x: jax.Array, axis_name: str, n: int,

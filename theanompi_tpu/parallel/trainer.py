@@ -16,7 +16,6 @@ supplies the compiled step + parameter layout:
 
 from __future__ import annotations
 
-import json
 import sys
 import time
 from contextlib import nullcontext
@@ -530,21 +529,21 @@ class BaseTrainer:
         accumulation depth, or model config is a hard refusal unless
         ``resume_force`` — a silent topology change corrupts the lineage
         (zero1 opt-state shards, stacked EASGD/GOSGD worker axes, and RNG
-        streams all depend on it).  ``n_epochs``/``verbose`` are excluded:
-        extending or quieting a run is a legitimate resume.
+        streams all depend on it).  The model-identity half is
+        :func:`~theanompi_tpu.utils.checkpoint.model_fingerprint` — ONE
+        sha definition shared with the serving consumer, so a ``tmserve``
+        process built from the same ``--set`` flags matches a training
+        manifest (see ``MODEL_FP_EXCLUDED`` there for why
+        ``n_epochs``/``verbose``/``bn_axis`` don't hash).
         """
-        import hashlib
+        from theanompi_tpu.utils.checkpoint import model_fingerprint
 
-        cfg = {k: repr(v) for k, v in self.model.config.items()
-               if k not in ("n_epochs", "verbose")}
-        blob = json.dumps(cfg, sort_keys=True).encode()
         exch = getattr(self, "exchanger", None)
         return {
             "mesh": {str(a): int(s) for a, s in self.mesh.shape.items()},
             "exchange": getattr(exch, "strategy", type(self).__name__),
             "n_subb": int(self.model.config.get("n_subb", 1) or 1),
-            "model": type(self.model).__name__,
-            "model_config_sha": hashlib.sha256(blob).hexdigest()[:16],
+            **model_fingerprint(self.model),
         }
 
     def save_checkpoint(self, epoch: int):

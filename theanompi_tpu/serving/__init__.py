@@ -1,0 +1,42 @@
+"""Serving path (ISSUE 6): continuous-batching inference for transformer_lm.
+
+The consumer the train-only stack was missing — trained checkpoints load
+read-only through the PR 5 verified chain and serve through:
+
+- :mod:`theanompi_tpu.serving.kv_cache` — paged KV cache (fixed blocks,
+  per-sequence block tables, alloc/free pool, reserved null block);
+- :mod:`theanompi_tpu.serving.engine` — compiled prefill/decode steps over
+  the model's own block stack, greedy + temperature/top-k sampling under
+  explicit ``(request, position)`` PRNG keys, optional int8 weights;
+- :mod:`theanompi_tpu.serving.scheduler` — continuous batching: admission
+  queue, per-step join/evict, longest-first preemption on pool pressure;
+- :mod:`theanompi_tpu.serving.quant` — int8 weight-only quantization in the
+  ``ring_int8`` per-chunk-scale + stochastic-rounding format;
+- :mod:`theanompi_tpu.serving.cli` — the ``tmserve`` entry point
+  (synthetic open-loop traffic, SERVE.json report).
+
+Import discipline (lint-enforced, ``tests/test_lint_resilience.py``): this
+package never imports the training side — no trainer, exchanger, optimizer
+or supervisor — and reads checkpoint bytes only through the verified
+loader.
+"""
+
+from theanompi_tpu.serving.engine import InferenceEngine, sample_tokens
+from theanompi_tpu.serving.kv_cache import BlockPool, PagedKVCache, blocks_for
+from theanompi_tpu.serving.quant import (
+    QuantizedTensor,
+    dequantize_tree,
+    quantize_tree,
+)
+from theanompi_tpu.serving.scheduler import (
+    Request,
+    Scheduler,
+    run_open_loop,
+    serve_report,
+)
+
+__all__ = [
+    "BlockPool", "InferenceEngine", "PagedKVCache", "QuantizedTensor",
+    "Request", "Scheduler", "blocks_for", "dequantize_tree",
+    "quantize_tree", "run_open_loop", "sample_tokens", "serve_report",
+]

@@ -1,0 +1,6 @@
+"""``python -m theanompi_tpu.serving`` == the ``tmserve`` console script."""
+
+from theanompi_tpu.serving.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

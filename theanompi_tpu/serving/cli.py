@@ -1,0 +1,234 @@
+"""tmserve: the serving CLI (ISSUE 6).
+
+Serves synthetic open-loop traffic against a ``TransformerLM``-family
+checkpoint through the continuous-batching engine and reports tokens/sec +
+p50/p99 time-to-first-token and per-token latency — the serving twin of
+``tmlauncher``, sharing its config surface (``--set`` k=v pairs must
+reproduce the training config: the verified load checks the model
+class + config sha recorded in the checkpoint manifest) and its exit-code
+contract (0 clean, 70 crash, 77 no verifiable checkpoint, 78 config error,
+one ``tmserve: error:`` stderr line each).
+
+Checkpoints load STRICTLY via the PR 5 verified chain
+(:func:`theanompi_tpu.utils.checkpoint.load_for_inference` — read-only:
+safe against a directory a live trainer owns); ``--serve-force`` mirrors
+``--resume-force`` for deliberate config drift.  Without
+``--checkpoint-dir`` the model serves its random init (a throughput bench
+needs weights, not learning).
+
+Example::
+
+    tmserve --modelclass TransformerLM \
+        --set dim=256 --set n_layers=4 --set seq_len=256 \
+        --checkpoint-dir ./ckpt --requests 64 --arrival-rate 32 \
+        --max-batch 8 --num-blocks 96 --quantize-int8 --out SERVE.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from theanompi_tpu.launcher import _parse_kv
+from theanompi_tpu.resilience.codes import EXIT_CKPT, EXIT_CONFIG, EXIT_CRASH
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tmserve",
+        description="Serve synthetic open-loop traffic from a trained "
+        "checkpoint through the continuous-batching inference engine.",
+        allow_abbrev=False,
+    )
+    p.add_argument("--modelfile",
+                   default="theanompi_tpu.models.transformer_lm")
+    p.add_argument("--modelclass", default="TransformerLM")
+    p.add_argument("--set", dest="model_set", action="append", default=[],
+                   metavar="K=V", help="model config entry (repeatable; "
+                   "must reproduce the training config for the checkpoint "
+                   "fingerprint to match)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="load weights via the verified chain (read-only; "
+                   "absent = serve the random init)")
+    p.add_argument("--serve-verify", default="fast",
+                   choices=["fast", "full", "none"],
+                   help="checkpoint verification level (default fast)")
+    p.add_argument("--serve-force", action="store_true",
+                   help="override the model-fingerprint check on load "
+                   "(mirrors tmlauncher --resume-force)")
+    # -- engine ------------------------------------------------------------
+    p.add_argument("--max-batch", type=int, default=8,
+                   help="fixed decode batch width (slots)")
+    p.add_argument("--block-size", type=int, default=16,
+                   help="KV-cache tokens per block")
+    p.add_argument("--num-blocks", type=int, default=None,
+                   help="KV block pool size (default: worst case; smaller "
+                   "values oversubscribe and rely on preemption)")
+    p.add_argument("--quantize-int8", action="store_true",
+                   help="int8 weight-only quantization of matmul weights "
+                   "(ring_int8 per-chunk-scale format)")
+    p.add_argument("--top-k", type=int, default=0,
+                   help="restrict sampling to the top-k logits (0 = off)")
+    # -- synthetic traffic -------------------------------------------------
+    p.add_argument("--requests", type=int, default=16)
+    p.add_argument("--prompt-len", type=int, default=16,
+                   help="synthetic prompt length (tokens)")
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--arrival-rate", type=float, default=0.0,
+                   help="open-loop Poisson arrival rate in requests/sec "
+                   "(0 = all requests arrive at t=0)")
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy; >0 samples under explicit PRNG keys")
+    p.add_argument("--seed", type=int, default=0)
+    # -- output ------------------------------------------------------------
+    p.add_argument("--telemetry-dir", default=None,
+                   help="serve.prefill/serve.decode spans + serve.* "
+                   "gauges as per-rank JSONL (trace.json exported at exit)")
+    p.add_argument("--out", default=None,
+                   help="write the report dict as JSON here (SERVE.json)")
+    p.add_argument("--quiet", action="store_true")
+    return p
+
+
+def _error_line(phase: str, e: BaseException) -> None:
+    print(f"tmserve: error: {phase}: {type(e).__name__}: {e}",
+          file=sys.stderr, flush=True)
+    if os.environ.get("THEANOMPI_DEBUG"):
+        import traceback
+
+        traceback.print_exc()
+
+
+def synthetic_requests(n: int, vocab: int, prompt_len: int,
+                       max_new_tokens: int, rate: float, seed: int,
+                       temperature: float = 0.0):
+    """Seeded open-loop request stream: uniform-random prompts, Poisson
+    arrivals at ``rate`` req/s (``rate=0`` = one burst at t=0)."""
+    import numpy as np
+
+    from theanompi_tpu.serving.scheduler import Request
+
+    rng = np.random.RandomState(seed)
+    t = 0.0
+    out = []
+    for rid in range(n):
+        if rate > 0:
+            t += float(rng.exponential(1.0 / rate))
+        out.append(Request(
+            rid=rid,
+            prompt=[int(x) for x in rng.randint(0, vocab, prompt_len)],
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            arrival_s=t if rate > 0 else 0.0,
+        ))
+    return out
+
+
+def serve(args) -> dict:
+    """Build model + engine + scheduler, run the synthetic load; -> report."""
+    import importlib
+
+    from theanompi_tpu.serving.engine import InferenceEngine
+    from theanompi_tpu.serving.scheduler import (
+        Scheduler,
+        run_open_loop,
+        serve_report,
+    )
+    from theanompi_tpu.utils.checkpoint import load_for_inference
+
+    cls = getattr(importlib.import_module(args.modelfile), args.modelclass)
+    model = cls(_parse_kv(args.model_set))
+    import jax
+
+    params, _state = model.init_params(jax.random.PRNGKey(args.seed))
+    epoch = None
+    if args.checkpoint_dir:
+        restored = load_for_inference(
+            args.checkpoint_dir, {"params": params},
+            verify=args.serve_verify, model=model, force=args.serve_force)
+        if restored is None:
+            raise FileNotFoundError(
+                f"no checkpoint in {args.checkpoint_dir} (tmserve does not "
+                f"serve random inits when a directory was given)")
+        epoch, _it, trees = restored
+        params = trees["params"]
+
+    telemetry = None
+    if args.telemetry_dir:
+        from theanompi_tpu.telemetry import Telemetry
+
+        telemetry = Telemetry(args.telemetry_dir)
+
+    engine = InferenceEngine(
+        model, params, block_size=args.block_size,
+        num_blocks=args.num_blocks, max_batch=args.max_batch,
+        quantize_int8=args.quantize_int8, top_k=args.top_k, seed=args.seed)
+    sched = Scheduler(engine, telemetry=telemetry)
+    reqs = synthetic_requests(
+        args.requests, model.data.vocab, args.prompt_len,
+        args.max_new_tokens, args.arrival_rate, args.seed,
+        args.temperature)
+    results, wall_s = run_open_loop(sched, reqs)
+    report = serve_report(results, wall_s, sched)
+    report["checkpoint_epoch"] = epoch
+    if engine.quant_stats:
+        report["quantization"] = engine.quant_stats
+    if telemetry is not None:
+        from theanompi_tpu.telemetry.metrics import SERVE_GAUGES
+
+        g_tps, g_active, g_free = SERVE_GAUGES
+        telemetry.gauge(g_tps, report["value"])
+        telemetry.gauge(g_active, 0)
+        telemetry.gauge(g_free, sched.pool.free_blocks)
+        telemetry.close()
+        telemetry.export_chrome_trace(
+            os.path.join(args.telemetry_dir, "trace.json"))
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Exit-code contract (shared with tmlauncher; see the README table):
+    0 clean, 70 serving crash, 77 checkpoint chain exhausted, 78 config
+    error — one ``tmserve:`` stderr line each."""
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    try:
+        args = build_parser().parse_args(argv)
+    except SystemExit as e:
+        # argparse exits 2 on bad flags — keep its contract
+        return int(e.code or 0)
+
+    from theanompi_tpu.utils.checkpoint import (
+        CheckpointCorruptError,
+        CheckpointFingerprintError,
+    )
+
+    try:
+        report = serve(args)
+    except CheckpointFingerprintError as e:
+        _error_line("load", e)
+        return EXIT_CONFIG
+    except CheckpointCorruptError as e:
+        _error_line("checkpoint", e)
+        return EXIT_CKPT
+    except (ImportError, AttributeError, TypeError, ValueError, KeyError,
+            FileNotFoundError, NotImplementedError) as e:
+        _error_line("config", e)
+        return EXIT_CONFIG
+    except Exception as e:
+        _error_line("serving", e)
+        return EXIT_CRASH
+    if args.out:
+        with open(args.out + ".tmp", "w") as f:
+            json.dump(report, f, indent=1)
+        os.replace(args.out + ".tmp", args.out)
+    print(json.dumps(report))
+    if not args.quiet and args.telemetry_dir:
+        print(f"tmserve: telemetry in {args.telemetry_dir} (trace.json "
+              f"for Perfetto)", file=sys.stderr, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,186 @@
+"""Inference engine: compiled prefill/decode steps for ``TransformerLM``.
+
+The engine is the pure-compute half of serving (the policy half — admission,
+preemption, batching — is :mod:`theanompi_tpu.serving.scheduler`): it owns
+the paged KV pools, the (optionally int8-quantized) params, and two jitted
+step functions driven against the model's serving path
+(``apply_prefill``/``apply_decode`` — the SAME block stack and param tree
+the trainer checkpoints, see :mod:`theanompi_tpu.models.transformer_lm`):
+
+- **prefill**: one sequence, the whole prompt in one forward.  Prompts pad
+  to power-of-two block multiples (bounded compile count: at most
+  ``log2(max_blocks_per_seq)+1`` prefill programs); causal masking keeps
+  end-padding out of every real position's context, and the first output
+  token samples from the last REAL position's logits.
+- **decode**: one token for every slot of a FIXED ``max_batch`` — the
+  continuous-batching invariant.  Inactive slots ride along masked (their
+  block tables point at the cache's null block); the step is compiled once.
+
+Sampling runs inside the step under explicit PRNG keys derived from
+``(request id, position)`` only — so a preempted-and-recomputed sequence
+resamples identically, and greedy (``temperature=0``) is pure argmax.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.serving.kv_cache import PagedKVCache, blocks_for
+from theanompi_tpu.serving.quant import (
+    dequantize_tree,
+    is_quantized_tree,
+    quantize_tree,
+)
+
+
+def sample_tokens(logits, temps, keys, top_k: int = 0):
+    """Per-row sampling: argmax where ``temps <= 0``, else temperature
+    softmax sampling (optionally over the top-``top_k`` logits).  ``logits``
+    ``[B, V]`` fp32, ``temps`` ``[B]``, ``keys`` ``[B]`` PRNG keys."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    if top_k and top_k < logits.shape[-1]:
+        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+        scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+    sampled = jax.vmap(
+        lambda l, k: jax.random.categorical(k, l))(scaled, keys)
+    return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+
+
+def _sample_key(base_key, rid, position):
+    """The (request, position)-only key derivation: preemption recompute
+    replays the identical sampling stream."""
+    return jax.random.fold_in(jax.random.fold_in(base_key, rid), position)
+
+
+class InferenceEngine:
+    """Compiled serving steps + cache state for one ``TransformerLM``.
+
+    ``num_blocks`` deliberately admits oversubscription: sized below
+    ``max_batch * blocks_per_seq + 1`` the pool can run out mid-decode,
+    which is the scheduler's preemption trigger (and the smoke test's).
+    """
+
+    def __init__(self, model, params, *, block_size: int = 16,
+                 num_blocks: int | None = None, max_batch: int = 8,
+                 quantize_int8: bool = False, quant_chunk: int = 1024,
+                 top_k: int = 0, seed: int = 0):
+        cfg = model.config
+        self.model = model
+        self.max_batch = int(max_batch)
+        self.block_size = int(block_size)
+        self.max_context = int(cfg["seq_len"])
+        self.max_blocks_per_seq = blocks_for(self.max_context, block_size)
+        if num_blocks is None:
+            num_blocks = max_batch * self.max_blocks_per_seq + 1
+        self.num_blocks = int(num_blocks)
+        self.top_k = int(top_k)
+        self._base_key = jax.random.PRNGKey(seed)
+        self.quant_stats = None
+        if quantize_int8:
+            params, self.quant_stats = quantize_tree(
+                params, jax.random.PRNGKey(seed ^ 0x51), quant_chunk)
+        self.params = params
+        heads, dim = cfg["heads"], cfg["dim"]
+        cache = PagedKVCache.create(
+            n_layers=cfg["n_layers"], num_blocks=self.num_blocks,
+            block_size=block_size, heads=heads, head_dim=dim // heads,
+            max_batch=max_batch, max_context=self.max_context,
+            dtype=model.precision.compute_dtype)
+        self._k, self._v = cache.k, cache.v
+        # k/v pools are donated: the step's .at[].set() writes update the
+        # pool buffers in place instead of copying two [L, blocks, bs, H,
+        # Dh] arrays per generated token (the cache docstring's contract)
+        self._decode_fn = jax.jit(self._decode_impl, donate_argnums=(1, 2))
+        self._prefill_fns: dict[int, object] = {}
+
+    @property
+    def quantized(self) -> bool:
+        return is_quantized_tree(self.params)
+
+    # -- compiled bodies -----------------------------------------------------
+    def _decode_impl(self, params, k, v, tables, lengths, tokens, temps,
+                     rids, base_key):
+        params = dequantize_tree(params)
+        cache = PagedKVCache(k, v, tables, self.block_size)
+        # the incoming token's 0-based position == tokens already cached
+        positions = lengths
+        logits, cache = self.model.apply_decode(
+            params, {}, cache, positions, tokens)
+        keys = jax.vmap(functools.partial(_sample_key, base_key))(
+            rids, positions + 1)
+        nxt = sample_tokens(logits, temps, keys, self.top_k)
+        return nxt, logits, cache.k, cache.v
+
+    def _prefill_impl(self, params, k, v, table_row, tokens, true_len,
+                      temp, rid, base_key):
+        params = dequantize_tree(params)
+        cache = PagedKVCache(
+            k, v, jnp.zeros((1, self.max_blocks_per_seq), jnp.int32),
+            self.block_size)
+        logits, cache = self.model.apply_prefill(
+            params, {}, cache, table_row, tokens[None, :])
+        last = jnp.take(logits[0], true_len - 1, axis=0)
+        key = _sample_key(base_key, rid, true_len)
+        nxt = sample_tokens(last[None], temp[None], key[None], self.top_k)
+        return nxt[0], last, cache.k, cache.v
+
+    # -- host API (the scheduler's surface) ----------------------------------
+    def pad_len(self, n_tokens: int) -> int:
+        """Prompt bucket: the smallest power-of-two number of blocks that
+        holds ``n_tokens`` (>= one block), capped at the max context."""
+        nb = 1
+        while nb * self.block_size < n_tokens:
+            nb *= 2
+        return min(nb, self.max_blocks_per_seq) * self.block_size
+
+    def prefill(self, table_row, tokens, temperature: float = 0.0,
+                rid: int = 0):
+        """Prefill one sequence; -> (first generated token: int, last-
+        position logits ``[V]`` np).  ``table_row``: the block ids backing
+        the prompt (padded internally with the null block)."""
+        p = len(tokens)
+        if p > self.max_context:
+            raise ValueError(f"prompt of {p} tokens > max context "
+                             f"{self.max_context}")
+        p_pad = self.pad_len(p)
+        if p_pad < p:
+            raise ValueError(f"prompt {p} > padded bucket {p_pad}")
+        row = list(table_row) + [PagedKVCache.NULL_BLOCK] * (
+            p_pad // self.block_size - len(table_row))
+        fn = self._prefill_fns.get(p_pad)
+        if fn is None:
+            fn = self._prefill_fns[p_pad] = jax.jit(
+                self._prefill_impl, donate_argnums=(1, 2))
+        toks = np.zeros((p_pad,), np.int32)
+        toks[:p] = tokens
+        nxt, last, self._k, self._v = fn(
+            self.params, self._k, self._v,
+            jnp.asarray(row, jnp.int32), jnp.asarray(toks),
+            jnp.asarray(p, jnp.int32),
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(rid, jnp.int32), self._base_key)
+        return int(nxt), np.asarray(last)
+
+    def decode(self, tables, lengths, tokens, temps, rids):
+        """One decode step over the fixed batch; -> (next tokens ``[B]``
+        np.int32, logits ``[B, V]`` np).  All arguments are host arrays of
+        length ``max_batch``; inactive slots pass table rows of nulls and
+        length 0 (their outputs are garbage by contract)."""
+        nxt, logits, self._k, self._v = self._decode_fn(
+            self.params, self._k, self._v,
+            jnp.asarray(tables, jnp.int32),
+            jnp.asarray(lengths, jnp.int32),
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(rids, jnp.int32), self._base_key)
+        return np.asarray(nxt), np.asarray(logits)
+
+    def fence(self):
+        """Block until the cache state is materialized (honest timing)."""
+        jax.block_until_ready((self._k, self._v))

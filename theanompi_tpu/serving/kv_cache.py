@@ -1,0 +1,201 @@
+"""Paged KV cache: fixed-size blocks, per-sequence block tables, alloc/free
+pool (the vLLM layout — Kwon et al., SOSP 2023 — at this repo's scale).
+
+Why paged: a contiguous per-sequence KV buffer must be sized for the WORST
+case (``max_batch x seq_len``), and continuous batching (Orca) makes the
+resident set churn — sequences of wildly different lengths join and leave
+every step.  Fixed-size blocks turn that into a heap problem: a sequence
+holds ``ceil(len / block_size)`` blocks scattered anywhere in the pool, the
+allocator hands blocks out and takes them back O(1), and the pool can be
+deliberately oversubscribed (admission is bounded by actual tokens, not
+worst-case reservations) with preemption as the pressure valve
+(:mod:`theanompi_tpu.serving.scheduler`).
+
+Layout: one pool per model, ``[L, num_blocks, block_size, H, Dh]`` for K and
+V — a block id indexes the same slot in every layer, so one block table per
+sequence serves the whole stack.  Block 0 is RESERVED as the null block:
+inactive batch slots and prefill padding point their table entries at it, so
+the fixed-shape decode step can scatter/gather unconditionally and the
+garbage lands where nothing unmasked ever reads.
+
+Attention here is the pure-JAX paged path (gather the table, mask by
+length) — the CPU tier-1 reference semantics.  Prefill attention does NOT
+go through this module at all: it runs inside the prompt through
+``MultiHeadAttention.attend`` (:mod:`theanompi_tpu.ops.attention`), i.e. the
+pallas flash kernels of ``ops/pallas_attention.py`` whenever the shape gate
+admits them — on TPU the O(P²) half of serving rides the same kernels as
+training, and only the O(P) per-token decode uses the gather path below.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class PagedKVCache:
+    """The device-side half of the cache: K/V pools + per-slot block tables.
+
+    A pytree (k/v/block_tables are leaves; ``block_size`` is static), so it
+    threads through jit-compiled prefill/decode steps functionally — every
+    write returns a new cache whose arrays XLA updates in place when the
+    caller donates the old ones.  Host-side bookkeeping (which blocks are
+    free, which slot maps to which request) lives in :class:`BlockPool` /
+    the scheduler, never on device.
+    """
+
+    k: jax.Array             # [L, num_blocks, block_size, H, Dh]
+    v: jax.Array             # [L, num_blocks, block_size, H, Dh]
+    block_tables: jax.Array  # [max_batch, max_blocks_per_seq] int32
+    block_size: int
+
+    NULL_BLOCK = 0
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.block_tables), (self.block_size,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, block_size=aux[0])
+
+    # -- shape properties ----------------------------------------------------
+    @property
+    def n_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_context(self) -> int:
+        return self.block_tables.shape[1] * self.block_size
+
+    @classmethod
+    def create(cls, n_layers: int, num_blocks: int, block_size: int,
+               heads: int, head_dim: int, max_batch: int,
+               max_context: int, dtype=jnp.float32) -> "PagedKVCache":
+        if num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is the "
+                             "reserved null block)")
+        max_blocks_per_seq = -(-max_context // block_size)
+        shape = (n_layers, num_blocks, block_size, heads, head_dim)
+        return cls(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            block_tables=jnp.zeros((max_batch, max_blocks_per_seq),
+                                   jnp.int32),
+            block_size=block_size,
+        )
+
+    def with_tables(self, tables) -> "PagedKVCache":
+        """New cache view with the given ``[max_batch, max_blocks]`` tables
+        (the scheduler re-materializes these from host state each step)."""
+        return PagedKVCache(self.k, self.v,
+                            jnp.asarray(tables, jnp.int32), self.block_size)
+
+    # -- writes --------------------------------------------------------------
+    def write_prefill(self, layer: int, k, v, table_row) -> "PagedKVCache":
+        """Write a whole prompt's K/V for one layer: ``k``/``v``
+        ``[1, P_pad, H, Dh]`` with ``P_pad`` a multiple of ``block_size``;
+        ``table_row`` ``[P_pad // block_size]`` block ids (padding entries
+        point at the null block — duplicate scatter indices are fine, the
+        null block's content is never read unmasked)."""
+        bs = self.block_size
+        p_pad = k.shape[1]
+        blocks_k = k[0].reshape(p_pad // bs, bs, *k.shape[2:])
+        blocks_v = v[0].reshape(p_pad // bs, bs, *v.shape[2:])
+        idx = jnp.asarray(table_row, jnp.int32)
+        return PagedKVCache(
+            self.k.at[layer, idx].set(blocks_k.astype(self.k.dtype)),
+            self.v.at[layer, idx].set(blocks_v.astype(self.v.dtype)),
+            self.block_tables, self.block_size)
+
+    def write_decode(self, layer: int, k, v, positions) -> "PagedKVCache":
+        """Append one token's K/V per batch slot: ``k``/``v`` ``[B, H, Dh]``
+        at ``positions`` ``[B]`` (inactive slots' tables point at the null
+        block, so their writes land in reserved garbage)."""
+        b = k.shape[0]
+        blk_idx = positions // self.block_size
+        blk = jnp.take_along_axis(
+            self.block_tables, blk_idx[:, None], axis=1)[:, 0]
+        off = positions % self.block_size
+        return PagedKVCache(
+            self.k.at[layer, blk, off].set(k.astype(self.k.dtype)),
+            self.v.at[layer, blk, off].set(v.astype(self.v.dtype)),
+            self.block_tables, self.block_size)
+
+    # -- paged attention (decode) --------------------------------------------
+    def attend_decode(self, layer: int, q, positions):
+        """Masked attention of one query token per slot over its cached
+        context: ``q`` ``[B, H, Dh]``, ``positions`` ``[B]`` (the query's
+        own 0-based position, already written) -> context ``[B, H, Dh]``.
+
+        fp32 softmax like the training paths; the mask admits positions
+        ``<= positions[b]``.  Inactive slots (position 0 pointing at the
+        null block) attend over one garbage token — finite garbage out,
+        discarded by the scheduler, and crucially never NaN (an all-masked
+        softmax would poison the lane)."""
+        scale = q.shape[-1] ** -0.5
+        # [B, nb, bs, H, Dh] -> [B, T_max, H, Dh]
+        kb = jnp.take(self.k[layer], self.block_tables, axis=0)
+        vb = jnp.take(self.v[layer], self.block_tables, axis=0)
+        b = q.shape[0]
+        t_max = self.max_context
+        kb = kb.reshape(b, t_max, *kb.shape[3:])
+        vb = vb.reshape(b, t_max, *vb.shape[3:])
+        qf = q.astype(jnp.float32) * scale
+        s = jnp.einsum("bhd,bthd->bht", qf, kb.astype(jnp.float32))
+        valid = jnp.arange(t_max)[None, :] <= positions[:, None]
+        s = jnp.where(valid[:, None, :], s, _NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        ctx = jnp.einsum("bht,bthd->bhd", p, vb.astype(jnp.float32))
+        return ctx.astype(q.dtype)
+
+
+class BlockPool:
+    """Host-side allocator over the pool's block ids.
+
+    Block 0 (the null block) is never handed out.  ``alloc`` is
+    all-or-nothing: a request that cannot get every block it asked for gets
+    none (the scheduler then preempts or defers — partial grants would
+    deadlock two half-admitted sequences against each other)."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2")
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, 0, -1))  # pop() -> low ids
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, blocks) -> None:
+        for b in blocks:
+            if not 0 < b < self.num_blocks:
+                raise ValueError(f"freeing block {b} outside pool "
+                                 f"(1..{self.num_blocks - 1})")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+            self._free.append(b)
+
+
+def blocks_for(n_tokens: int, block_size: int) -> int:
+    """Blocks a sequence of ``n_tokens`` occupies (ceil division)."""
+    return -(-n_tokens // block_size)

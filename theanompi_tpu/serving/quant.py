@@ -1,0 +1,119 @@
+"""int8 weight-only quantization for serving (ISSUE 6).
+
+Matmul weights are the HBM resident set of an inference server; int8 halves
+(vs bf16) or quarters (vs fp32) it, which is capacity for more KV-cache
+blocks — i.e. more concurrent sequences — on the same chip.  The format
+reuses the ``ring_int8`` exchange strategy's primitives
+(:mod:`theanompi_tpu.ops.quant`): per-chunk fp32 scale + stochastic
+rounding under an explicit PRNG key, so quantization is a seeded,
+reproducible, zero-mean transform.
+
+Quantized leaves become :class:`QuantizedTensor` pytree nodes (int8 payload
++ fp32 scales as children, shape/dtype static), so a quantized param tree
+jits through the same prefill/decode step functions — the engine calls
+:func:`dequantize_tree` INSIDE the compiled step, which keeps the int8
+bytes resident and materializes fp32 weights only transiently per step
+(XLA fuses the dequant into the consuming matmul's operand read).
+
+Only matmul weights quantize: attention q/k/v/o and FFN ``w`` leaves, MoE
+expert ``up_w``/``down_w`` stacks, and the LM head.  Embedding and position
+tables (gathers, not matmuls), LayerNorm scale/bias, biases, and MoE gate
+weights (tiny, routing-critical) stay in their checkpoint dtype.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.ops.quant import dequantize_chunked, quantize_chunked
+
+#: default elements per quantization chunk (one fp32 scale each): small
+#: enough that a tiny test model gets real per-chunk granularity, large
+#: enough that scale overhead stays < 0.1% at fp32
+DEFAULT_CHUNK_ELEMS = 1024
+
+#: leaf names that are matmul weights (see module docstring)
+_MATMUL_LEAF_NAMES = ("w", "up_w", "down_w")
+#: path components whose subtrees never quantize
+_SKIP_COMPONENTS = ("embedding", "positionembedding", "gate")
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QuantizedTensor:
+    """One quantized leaf: ``q [n_chunks, chunk]`` int8 + ``scales
+    [n_chunks]`` fp32, with the original shape/dtype as static aux data."""
+
+    q: jax.Array
+    scales: jax.Array
+    shape: tuple
+    dtype: object
+
+    def tree_flatten(self):
+        return (self.q, self.scales), (self.shape, str(self.dtype))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], jnp.dtype(aux[1]))
+
+    def dequantize(self) -> jax.Array:
+        return dequantize_chunked(self.q, self.scales, self.shape,
+                                  self.dtype)
+
+    @property
+    def nbytes_quantized(self) -> int:
+        return int(self.q.size + 4 * self.scales.size)
+
+
+def _should_quantize(path, leaf) -> bool:
+    parts = [str(getattr(p, "key", p)) for p in path]
+    if any(skip in part for part in parts for skip in _SKIP_COMPONENTS):
+        return False
+    if parts[-1] not in _MATMUL_LEAF_NAMES:
+        return False
+    return (hasattr(leaf, "dtype")
+            and jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+            and getattr(leaf, "ndim", 0) >= 2)
+
+
+def quantize_tree(params, key, chunk_elems: int = DEFAULT_CHUNK_ELEMS,
+                  predicate=_should_quantize):
+    """Quantize the matmul-weight leaves of a param tree; -> (tree with
+    :class:`QuantizedTensor` nodes, stats dict).  Deterministic in ``key``
+    (each leaf folds its flat index into the stream)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out, n_q, bytes_before, bytes_after = [], 0, 0, 0
+    for i, (path, leaf) in enumerate(flat):
+        if predicate(path, leaf):
+            q, scales = quantize_chunked(
+                jnp.asarray(leaf), jax.random.fold_in(key, i), chunk_elems)
+            qt = QuantizedTensor(q, scales, tuple(leaf.shape),
+                                 jnp.asarray(leaf).dtype)
+            out.append(qt)
+            n_q += 1
+            bytes_before += int(jnp.asarray(leaf).nbytes)
+            bytes_after += qt.nbytes_quantized
+        else:
+            out.append(leaf)
+    stats = {"quantized_leaves": n_q, "total_leaves": len(flat),
+             "bytes_before": bytes_before, "bytes_after": bytes_after}
+    return jax.tree_util.tree_unflatten(treedef, out), stats
+
+
+def dequantize_tree(params):
+    """Materialize fp-typed weights from a (possibly) quantized tree.
+    Identity on unquantized leaves; call INSIDE jit so XLA fuses the
+    dequant into the consuming matmuls."""
+    return jax.tree_util.tree_map(
+        lambda leaf: leaf.dequantize()
+        if isinstance(leaf, QuantizedTensor) else leaf,
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+
+
+def is_quantized_tree(params) -> bool:
+    return any(isinstance(leaf, QuantizedTensor)
+               for leaf in jax.tree_util.tree_leaves(
+                   params, is_leaf=lambda x: isinstance(x, QuantizedTensor)))

@@ -1,0 +1,337 @@
+"""Continuous batching: admission, per-step join/evict, preemption (Orca).
+
+Static batching serves a batch until its LONGEST member finishes — every
+other slot idles at the tail, and new arrivals wait for the whole batch.
+Continuous batching (Yu et al., OSDI 2022) rebuilds the batch every
+iteration instead: finished sequences evict and free their cache blocks at
+the step they finish, queued requests join (prefill) the moment a slot and
+blocks are available, and the decode step always runs the full fixed-shape
+batch with inactive slots masked (so the compiled program never changes).
+
+Block-pool pressure resolves by **preempting the longest active sequence**
+(free all its blocks, push the request back to the queue front): longest
+frees the most blocks per eviction, and its recompute-prefill is the one
+most amortized by batching.  Preemption is recompute-style (vLLM's default):
+the re-prefilled prefix is ``prompt + tokens generated so far``, and because
+sampling keys derive from ``(request id, position)`` only
+(:mod:`theanompi_tpu.serving.engine`), the replayed sequence continues
+exactly where it left off — greedy or sampled.
+
+All telemetry flows through the names registered in
+:mod:`theanompi_tpu.telemetry.metrics` (``SERVE_*``); latency percentiles
+are also tracked host-side so the SERVE report works with telemetry off.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from dataclasses import field
+
+import numpy as np
+
+from theanompi_tpu.serving.kv_cache import BlockPool, PagedKVCache, blocks_for
+from theanompi_tpu.telemetry.metrics import (  # registered names (ISSUE 6)
+    SERVE_COUNTERS,
+    SERVE_HISTOGRAMS,
+    SERVE_INSTANTS,
+    SERVE_SPANS,
+)
+
+_SPAN_PREFILL, _SPAN_DECODE = SERVE_SPANS
+_INST_ADMIT, _INST_PREEMPT, _INST_FINISH = SERVE_INSTANTS
+_HIST_TOKEN_MS, _HIST_TTFT_MS = SERVE_HISTOGRAMS
+_CNT_TOKENS, _CNT_PREEMPTIONS, _CNT_REQUESTS = SERVE_COUNTERS
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.  ``arrival_s`` is the open-loop arrival
+    offset (seconds from traffic start) — the driver submits the request
+    when the clock passes it, regardless of server state (open loop)."""
+
+    rid: int
+    prompt: list[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    arrival_s: float = 0.0
+    # -- filled in by the scheduler -----------------------------------------
+    generated: list[int] = field(default_factory=list)
+    n_preemptions: int = 0
+    t_submit: float | None = None
+    t_first_token: float | None = None
+    t_done: float | None = None
+
+
+class Scheduler:
+    """Continuous-batching scheduler over one :class:`InferenceEngine`."""
+
+    def __init__(self, engine, telemetry=None, eos_token: int | None = None):
+        self.engine = engine
+        self.telemetry = telemetry
+        self.eos_token = eos_token
+        self.pool = BlockPool(engine.num_blocks)
+        self.queue: deque[Request] = deque()
+        b, nb = engine.max_batch, engine.max_blocks_per_seq
+        self.slots: list[Request | None] = [None] * b
+        self._blocks: list[list[int]] = [[] for _ in range(b)]
+        self._tables = np.zeros((b, nb), np.int32)
+        self._lengths = np.zeros((b,), np.int32)
+        self._tokens = np.zeros((b,), np.int32)
+        self._temps = np.zeros((b,), np.float32)
+        self._rids = np.zeros((b,), np.int32)
+        self.n_steps = 0
+        self.token_ms: list[float] = []
+        self.ttft_ms: list[float] = []
+        self.n_preemptions = 0
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def n_active(self) -> int:
+        return sum(r is not None for r in self.slots)
+
+    @property
+    def idle(self) -> bool:
+        return self.n_active == 0 and not self.queue
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, req: Request) -> None:
+        total = len(req.prompt) + req.max_new_tokens
+        if not req.prompt:
+            raise ValueError(f"request {req.rid}: empty prompt")
+        if total > self.engine.max_context:
+            raise ValueError(
+                f"request {req.rid}: prompt+max_new_tokens = {total} > "
+                f"max context {self.engine.max_context}")
+        if blocks_for(total, self.engine.block_size) > self.pool.num_blocks - 1:
+            raise ValueError(
+                f"request {req.rid}: needs "
+                f"{blocks_for(total, self.engine.block_size)} blocks, pool "
+                f"has {self.pool.num_blocks - 1} — num_blocks too small for "
+                f"even one sequence")
+        req.t_submit = time.perf_counter()
+        self.queue.append(req)
+
+    # -- internals -----------------------------------------------------------
+    def _emit(self, name: str, **fields) -> None:
+        if self.telemetry is not None:
+            self.telemetry.instant(name, **fields)
+
+    def _clear_slot(self, slot: int) -> None:
+        self.slots[slot] = None
+        self._blocks[slot] = []
+        self._tables[slot, :] = PagedKVCache.NULL_BLOCK
+        self._lengths[slot] = 0
+        self._tokens[slot] = 0
+        self._temps[slot] = 0.0
+        self._rids[slot] = 0
+
+    def _finish(self, slot: int, finished: list[Request]) -> None:
+        req = self.slots[slot]
+        self.pool.free(self._blocks[slot])
+        self._clear_slot(slot)
+        req.t_done = time.perf_counter()
+        if self.telemetry is not None:
+            self.telemetry.count(_CNT_REQUESTS)
+        self._emit(_INST_FINISH, request=req.rid,
+                   generated=len(req.generated))
+        finished.append(req)
+
+    def _preempt(self, slot: int) -> None:
+        req = self.slots[slot]
+        self.pool.free(self._blocks[slot])
+        self._clear_slot(slot)
+        req.n_preemptions += 1
+        self.n_preemptions += 1
+        if self.telemetry is not None:
+            self.telemetry.count(_CNT_PREEMPTIONS)
+        self._emit(_INST_PREEMPT, request=req.rid,
+                   held_tokens=len(req.prompt) + len(req.generated))
+        self.queue.appendleft(req)  # rejoin first: it already holds work
+
+    def _admit(self, finished: list[Request]) -> None:
+        """Prefill queued requests into free slots while blocks last."""
+        while self.queue:
+            try:
+                slot = self.slots.index(None)
+            except ValueError:
+                return
+            req = self.queue[0]
+            prefix = req.prompt + req.generated
+            need = blocks_for(len(prefix), self.engine.block_size)
+            row = self.pool.alloc(need)
+            if row is None:
+                if self.n_active == 0:
+                    # cannot happen for a submit()-validated request unless
+                    # the pool leaked; fail loudly rather than spin forever
+                    raise RuntimeError(
+                        f"request {req.rid} cannot be admitted into an "
+                        f"EMPTY server ({need} blocks needed, "
+                        f"{self.pool.free_blocks} free)")
+                return
+            self.queue.popleft()
+            span = (self.telemetry.span(_SPAN_PREFILL, request=req.rid,
+                                        prompt=len(prefix), slot=slot)
+                    if self.telemetry is not None else None)
+            if span is not None:
+                span.__enter__()
+            try:
+                # prefill returns a host int — already materialized, so the
+                # span close measures execution, not dispatch
+                tok, _ = self.engine.prefill(row, prefix, req.temperature,
+                                             req.rid)
+            finally:
+                if span is not None:
+                    span.__exit__(None, None, None)
+            now = time.perf_counter()
+            if req.t_first_token is None:
+                req.t_first_token = now
+                ttft = (now - req.t_submit) * 1e3
+                self.ttft_ms.append(ttft)
+                if self.telemetry is not None:
+                    self.telemetry.observe(_HIST_TTFT_MS, ttft)
+            req.generated.append(tok)
+            if self.telemetry is not None:
+                self.telemetry.count(_CNT_TOKENS)
+            self._emit(_INST_ADMIT, request=req.rid, slot=slot,
+                       prefix=len(prefix), blocks=need,
+                       resumed=req.n_preemptions > 0)
+            self.slots[slot] = req
+            self._blocks[slot] = row
+            self._tables[slot, :] = PagedKVCache.NULL_BLOCK
+            self._tables[slot, :need] = row
+            self._lengths[slot] = len(prefix)
+            self._tokens[slot] = tok
+            self._temps[slot] = req.temperature
+            self._rids[slot] = req.rid
+            if self._done(req):
+                self._finish(slot, finished)
+
+    def _done(self, req: Request) -> bool:
+        if len(req.generated) >= req.max_new_tokens:
+            return True
+        return (self.eos_token is not None
+                and req.generated
+                and req.generated[-1] == self.eos_token)
+
+    def _ensure_capacity(self) -> None:
+        """Every active slot whose NEXT token starts a new cache block must
+        get one before the decode step; exhaustion preempts the longest
+        active sequence and retries."""
+        for slot in range(self.engine.max_batch):
+            if self.slots[slot] is None:
+                continue
+            if self._lengths[slot] % self.engine.block_size != 0:
+                continue
+            while self.slots[slot] is not None:
+                got = self.pool.alloc(1)
+                if got is not None:
+                    n_used = blocks_for(int(self._lengths[slot]),
+                                        self.engine.block_size)
+                    self._blocks[slot].extend(got)
+                    self._tables[slot, n_used] = got[0]
+                    break
+                victim = max(
+                    (s for s in range(self.engine.max_batch)
+                     if self.slots[s] is not None),
+                    key=lambda s: int(self._lengths[s]))
+                self._preempt(victim)
+
+    def step(self) -> list[Request]:
+        """One scheduler iteration: admit, secure blocks, decode the fixed
+        batch, account the new tokens; -> the requests finished this step."""
+        finished: list[Request] = []
+        self._admit(finished)
+        if self.n_active == 0:
+            return finished
+        self._ensure_capacity()
+        active = [s for s in range(self.engine.max_batch)
+                  if self.slots[s] is not None]
+        if not active:  # capacity pressure preempted everyone admitted
+            return finished
+        span = None
+        if self.telemetry is not None:
+            span = self.telemetry.span(
+                _SPAN_DECODE, step=self.n_steps, batch=len(active),
+                requests=[int(self._rids[s]) for s in active])
+            span.__enter__()
+        t0 = time.perf_counter()
+        try:
+            nxt, _ = self.engine.decode(self._tables, self._lengths,
+                                        self._tokens, self._temps,
+                                        self._rids)
+        finally:
+            if span is not None:  # decode() returned host arrays: fenced
+                span.__exit__(None, None, None)
+        step_ms = (time.perf_counter() - t0) * 1e3
+        self.n_steps += 1
+        for slot in active:
+            req = self.slots[slot]
+            self._lengths[slot] += 1  # the fed token is now cached
+            tok = int(nxt[slot])
+            req.generated.append(tok)
+            self._tokens[slot] = tok
+            self.token_ms.append(step_ms)
+            if self.telemetry is not None:
+                self.telemetry.count(_CNT_TOKENS)
+                self.telemetry.observe(_HIST_TOKEN_MS, step_ms)
+            if self._done(req):
+                self._finish(slot, finished)
+        return finished
+
+
+def run_open_loop(scheduler: Scheduler, requests: list[Request],
+                  poll_s: float = 0.002) -> tuple[dict[int, Request], float]:
+    """Drive synthetic open-loop traffic: each request is submitted when the
+    wall clock passes its ``arrival_s`` (arrivals never wait on the server —
+    that is what makes the load open-loop), then the scheduler steps until
+    every request finishes.  -> ({rid: finished request}, wall seconds)."""
+    pending = deque(sorted(requests, key=lambda r: r.arrival_s))
+    results: dict[int, Request] = {}
+    t0 = time.perf_counter()
+    while len(results) < len(requests):
+        now = time.perf_counter() - t0
+        while pending and pending[0].arrival_s <= now:
+            scheduler.submit(pending.popleft())
+        if scheduler.idle:
+            if pending:
+                time.sleep(min(poll_s, max(pending[0].arrival_s - now, 0.0)))
+            continue
+        for req in scheduler.step():
+            results[req.rid] = req
+    return results, time.perf_counter() - t0
+
+
+def serve_report(results: dict[int, Request], wall_s: float,
+                 scheduler: Scheduler) -> dict:
+    """The SERVE.json artifact: throughput + latency percentiles."""
+    eng = scheduler.engine
+    n_tokens = sum(len(r.generated) for r in results.values())
+
+    def pct(xs):
+        if not xs:
+            return {}
+        arr = np.asarray(xs)
+        return {"p50": round(float(np.percentile(arr, 50)), 3),
+                "p99": round(float(np.percentile(arr, 99)), 3)}
+
+    return {
+        "metric": "serve_tokens_per_sec",
+        "value": round(n_tokens / wall_s, 2) if wall_s > 0 else 0.0,
+        "unit": "tokens/sec",
+        "requests": len(results),
+        "generated_tokens": n_tokens,
+        "wall_s": round(wall_s, 3),
+        "ttft_ms": pct(scheduler.ttft_ms),
+        "token_ms": pct(scheduler.token_ms),
+        "preemptions": scheduler.n_preemptions,
+        "decode_steps": scheduler.n_steps,
+        "quantized_int8": eng.quantized,
+        "config": {
+            "block_size": eng.block_size,
+            "num_blocks": eng.num_blocks,
+            "max_batch": eng.max_batch,
+            "max_context": eng.max_context,
+        },
+    }

@@ -51,6 +51,25 @@ def hlo_collective_counts(hlo_text: str) -> dict[str, int]:
     return counts
 
 
+# -- serving metric names (ISSUE 6) ------------------------------------------
+# The serving scheduler emits through these registered names ONLY (it
+# imports them from here — one source of truth, so dashboards and the
+# Chrome-trace test can't drift from what the code emits).  Span semantics:
+# ``serve.prefill`` wraps one sequence's full-prompt forward (tags:
+# ``request``, ``prompt``, ``slot``); ``serve.decode`` wraps one fixed-batch
+# decode step (tags: ``step``, ``batch``, ``requests`` — the per-request ids
+# threaded through the trace).  Both close over materialized host results,
+# so they measure execution, not dispatch; in the single-threaded serve loop
+# they are disjoint by construction (locked by test).
+
+SERVE_SPANS = ("serve.prefill", "serve.decode")
+SERVE_INSTANTS = ("serve.admit", "serve.preempt", "serve.finish")
+#: histograms: per-token decode latency and time-to-first-token, both ms
+SERVE_HISTOGRAMS = ("serve.token_ms", "serve.ttft_ms")
+SERVE_GAUGES = ("serve.tokens_per_sec", "serve.active", "serve.free_blocks")
+SERVE_COUNTERS = ("serve.tokens", "serve.preemptions", "serve.requests")
+
+
 class MetricsRegistry:
     """Named counters (monotonic totals), gauges (last value), histograms
     (bounded sample windows with percentile readout)."""

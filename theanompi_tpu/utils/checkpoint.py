@@ -284,29 +284,45 @@ def _normalize_fp(fp: dict) -> dict:
 
 
 def check_fingerprint(manifest: dict, mine: dict | None,
-                      npz_path: str, force: bool = False) -> None:
+                      npz_path: str, force: bool = False,
+                      subset: bool = False) -> None:
     """Refuse a topology mismatch (or warn, under ``force``).
 
     Skipped when either side carries no fingerprint (bare library use,
     pre-integrity manifests) — absence is not a mismatch.
+
+    ``subset=True`` compares only the keys ``mine`` provides — the serving
+    consumer's mode (ISSUE 6): an inference process has no mesh or exchange
+    strategy to match, but the model class and config MUST match (a
+    checkpoint restored into a differently-shaped model fails loudly at
+    best and silently mismaps at worst).
     """
     theirs = manifest.get("fingerprint")
     if theirs is None or mine is None:
         return
     mine = _normalize_fp(mine)
     theirs = _normalize_fp(theirs)
+    if subset:
+        theirs = {k: v for k, v in theirs.items() if k in mine}
     if mine == theirs:
         return
     diffs = ", ".join(
         f"{k}: checkpoint={theirs.get(k)!r} != run={mine.get(k)!r}"
         for k in sorted(set(theirs) | set(mine))
         if theirs.get(k) != mine.get(k))
-    msg = (f"{os.path.basename(npz_path)}: run fingerprint mismatch — this "
-           f"checkpoint was written under a different topology ({diffs}). "
-           f"Resuming would desynchronize or silently retrain; pass "
-           f"--resume-force (rule key resume_force=True) to override.")
+    if subset:
+        what = ("this checkpoint was trained with a different model "
+                f"class/config ({diffs}). Serving it would silently mismap "
+                f"weights; reproduce the training --set flags, or pass "
+                f"--serve-force to override")
+    else:
+        what = ("this checkpoint was written under a different topology "
+                f"({diffs}). Resuming would desynchronize or silently "
+                f"retrain; pass --resume-force (rule key resume_force=True) "
+                f"to override")
+    msg = f"{os.path.basename(npz_path)}: run fingerprint mismatch — {what}."
     if force:
-        print(f"checkpoint: WARNING: {msg} — proceeding (resume_force)",
+        print(f"checkpoint: WARNING: {msg} — proceeding (force)",
               file=sys.stderr, flush=True)
         return
     raise CheckpointFingerprintError(msg)
@@ -360,11 +376,22 @@ class Checkpointer:
     def __init__(self, directory: str, keep: int = 3,
                  async_save: bool = False, telemetry=None,
                  fault_plan=None, fingerprint=None,
-                 resume_force: bool = False, sweep_debris: bool = True):
+                 resume_force: bool = False, sweep_debris: bool = True,
+                 read_only: bool = False, fingerprint_subset: bool = False):
         self.directory = directory
         self.keep = keep
         self.async_save = async_save
         self.telemetry = telemetry
+        # ISSUE 6: a read-only consumer (load_for_inference) never mutates
+        # the directory — no debris sweep, no dirty marker, no quarantine,
+        # no resilience events, and save() refuses outright.  Safe to point
+        # at a directory a LIVE training writer owns.
+        self.read_only = read_only
+        # serving compares only the model-identity fingerprint keys (see
+        # check_fingerprint(subset=True))
+        self.fingerprint_subset = fingerprint_subset
+        if read_only:
+            sweep_debris = False
         # ISSUE 4/5: deterministic `checkpoint:ACTION@EPOCH` injection —
         # `fail` raises on the writer (delivered at the next join, exactly
         # like a real disk failure); `truncate`/`bitflip`/`manifest_drop`
@@ -431,7 +458,7 @@ class Checkpointer:
         it exits cleanly — its presence at resume time means the previous
         writer died mid-run, which is exactly when a bit-level ``full``
         verify is worth its read cost."""
-        if self._marked_dirty:
+        if self._marked_dirty or self.read_only:
             return
         with open(self._dirty_path(), "w") as f:
             f.write("1")
@@ -509,6 +536,10 @@ class Checkpointer:
         still be writing — at most one save is in flight (this call joins
         the previous one first, re-raising its error if it failed).
         """
+        if self.read_only:
+            raise RuntimeError(
+                "Checkpointer is read-only (load_for_inference): save() "
+                "refused — the directory belongs to a training writer")
         self.join_pending()
         tel = self.telemetry
         with (tel.span("checkpoint.snapshot", epoch=epoch)
@@ -705,7 +736,18 @@ class Checkpointer:
     def quarantine(self, epoch: int, reason: str) -> list[str]:
         """Move a bad checkpoint (``.npz`` + manifest) under
         ``<dir>/corrupt/`` — out of the chain and retention, but preserved
-        for forensics — and record the event."""
+        for forensics — and record the event.
+
+        A read-only consumer (ISSUE 6) steps back over the bad file WITHOUT
+        touching it: the training writer owns the directory, and moving its
+        files (or writing its resilience.json) from a serving process would
+        race its scrubber/retention.  The corrupt file stays for the owner
+        to quarantine."""
+        if self.read_only:
+            print(f"checkpoint: read-only consumer skipping epoch {epoch} "
+                  f"({reason}) — left in place for the owning writer",
+                  file=sys.stderr, flush=True)
+            return []
         qdir = os.path.join(self.directory, "corrupt")
         os.makedirs(qdir, exist_ok=True)
         moved = []
@@ -738,7 +780,12 @@ class Checkpointer:
         """Audit + repoint after the chain stepped past corrupt files:
         the ``ckpt.fallback`` event lands in ``resilience.json`` and
         telemetry, and ``latest.json`` is rewritten to the verified epoch
-        so the pointer never advertises a quarantined file."""
+        so the pointer never advertises a quarantined file.
+
+        Read-only consumers record nothing and repoint nothing — both files
+        belong to the training writer."""
+        if self.read_only:
+            return
         self._record_event("ckpt.fallback", bad_epochs=skipped,
                            restored_epoch=epoch, verify=verify)
         if self.telemetry is not None:
@@ -800,7 +847,8 @@ class Checkpointer:
         -> its manifest."""
         man = verify_file(self._path(epoch), level=level)
         check_fingerprint(man, self._resolved_fingerprint(),
-                          self._path(epoch), force=self.resume_force)
+                          self._path(epoch), force=self.resume_force,
+                          subset=self.fingerprint_subset)
         return man
 
     def load_latest_verified(self, templates: dict,
@@ -1030,6 +1078,62 @@ class Checkpointer:
             sub = multihost_utils.broadcast_one_to_all(sub)
             out[name] = _restore_into(template, sub)
         return out
+
+
+# -- read-only consumer API (ISSUE 6: the serving path) -----------------------
+
+#: model-config keys excluded from the identity sha: ``n_epochs``/``verbose``
+#: because extending or quieting a run is a legitimate resume, and
+#: ``bn_axis`` because the rule injects it from the worker count
+#: (``BSP.adjust_model_config``) — a consumer process constructed from the
+#: same ``--set`` flags can never reproduce it, and its lineage effect is
+#: already guarded by the ``mesh`` key of the full training fingerprint
+MODEL_FP_EXCLUDED = ("n_epochs", "verbose", "bn_axis")
+
+
+def model_fingerprint(model) -> dict:
+    """The model-identity SUBSET of the run fingerprint — the two keys a
+    consumer process can (and must) reproduce: the model class name and the
+    sha of its config.  ``BaseTrainer._run_fingerprint`` stamps exactly
+    this into training manifests, so a serving process constructed with
+    the same ``--set`` flags matches."""
+    import hashlib
+
+    cfg = {k: repr(v) for k, v in model.config.items()
+           if k not in MODEL_FP_EXCLUDED}
+    blob = json.dumps(cfg, sort_keys=True).encode()
+    return {"model": type(model).__name__,
+            "model_config_sha": hashlib.sha256(blob).hexdigest()[:16]}
+
+
+def load_for_inference(directory: str, templates: dict,
+                       verify: str = "fast", model=None,
+                       force: bool = False):
+    """Read-only verified restore for serving (ISSUE 6).
+
+    The documented consumer entry point: loads the newest checkpoint that
+    passes verification, stepping back over corrupt ones, WITHOUT ever
+    writing to the directory — no ``dirty`` marker, no debris sweep, no
+    quarantine moves, no ``resilience.json``/``latest.json`` rewrites, no
+    retention or scrub.  Safe to call against a directory a live training
+    writer owns (its scrubber/retention/async-writer guarantees are
+    untouched — locked by test).
+
+    ``model``: when given, the checkpoint's fingerprint must match the
+    model's class + config sha (:func:`model_fingerprint`; mesh/exchange
+    keys are ignored — a serving process has neither).  ``force=True``
+    (the ``tmserve --serve-force`` flag, mirroring ``--resume-force``)
+    downgrades a mismatch to a stderr warning.
+
+    -> ``(epoch, iteration, restored_trees)`` or ``None`` (empty dir);
+    raises :class:`CheckpointChainExhausted` /
+    :class:`CheckpointFingerprintError` like the training-side chain.
+    """
+    cp = Checkpointer(
+        directory, read_only=True, fingerprint_subset=True,
+        fingerprint=model_fingerprint(model) if model is not None else None,
+        resume_force=force)
+    return cp.load_latest_verified(templates, verify=verify)
 
 
 # -- scrubber CLI ------------------------------------------------------------
