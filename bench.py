@@ -175,7 +175,7 @@ def step_flops(trainer, batch) -> float | None:
             analysis = analysis[0]
         fl = float(analysis.get("flops", 0.0))
         return fl if fl > 0 else None
-    except Exception:
+    except Exception:  # lint: swallow-ok — best-effort probe, None = n/a
         return None
 
 
@@ -450,14 +450,15 @@ def _measure():
         with open(path + ".tmp", "w") as f:
             json.dump(extra, f, indent=1)
         os.replace(path + ".tmp", path)
-    except Exception as e:  # the primary line must survive regardless
+    except Exception as e:  # lint: swallow-ok — the primary bench line
+        # must survive a side-bench failure; the error is printed, not lost
         print(f"transformer side-bench failed: {e}", file=sys.stderr)
     finally:
         os.environ.update(saved)
         try:
             os.remove(path + ".tmp")
-        except OSError:  # no leftover, or something unremovable — not worth
-            pass         # failing the primary line over
+        except OSError:  # lint: swallow-ok — no leftover, or something
+            pass         # unremovable: not worth failing the primary line
 
 
 def _names_backend_init(msg_low: str) -> bool:

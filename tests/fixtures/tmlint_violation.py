@@ -1,0 +1,39 @@
+"""Deliberately-seeded tmlint violations (ISSUE 7 satellite).
+
+NOT package code — lives under tests/fixtures/ so the clean-package
+tier-1 sweep never sees it.  ``test_tmlint.py`` points the CLI at this
+file and asserts a non-zero exit with one finding per seeded class.
+"""
+
+import time
+
+import numpy as np
+
+
+def wall_clock_violation():
+    return time.time()  # seeded: rule `wall`
+
+
+def swallow_violation():
+    try:
+        wall_clock_violation()
+    except Exception:
+        pass  # seeded: rule `swallow`
+
+
+def np_load_violation(path):
+    return np.load(path)  # seeded: rule `np-load`
+
+
+def donated_escape_violation(x):
+    return np.asarray(x)  # seeded: rule `donated-escape`
+
+
+def exit_code_violation(rc):
+    return rc == 77  # seeded: rule `exit-code`
+
+
+def suppression_violation():
+    # seeded: rule `suppression` (marker with no justification)
+    stamp = time.time()  # lint: wall-ok
+    return stamp

@@ -1,0 +1,468 @@
+"""tmlint subsystem tests (ISSUE 7): per-rule fixtures, suppression
+grammar, the declared layer DAG, CLI exit contract, JSON report schema,
+and THE tier-1 acceptance: the full rule set runs clean over the package.
+
+Fixture style: each rule gets synthetic sources asserting both the
+firing and the non-firing case — the rule must catch its bug class AND
+must not cry wolf on the idioms the repo actually uses (the conditional
+``a = a.copy()`` ownership check, consumed-by-call asarray, early-return
+guards above a rebinding, lazy cycle-breaking imports).
+"""
+
+import json
+
+import pytest
+
+from theanompi_tpu.analysis import cli, core
+from theanompi_tpu.analysis import layers as L
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+
+def run_src(tmp_path, source, rules=None, rel="fx.py"):
+    """Lint one synthetic source; -> (unsuppressed, suppressed) lists."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(source)
+    findings, _ = core.lint_paths([str(path)], rules, root=str(tmp_path))
+    return ([f for f in findings if not f.suppressed],
+            [f for f in findings if f.suppressed])
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 acceptance: the whole package is clean
+# ---------------------------------------------------------------------------
+
+
+def test_package_runs_clean_under_the_full_rule_set():
+    """Zero unsuppressed findings over theanompi_tpu/ + bench.py with
+    every registered rule on — the ISSUE 7 acceptance criterion.  Every
+    suppression in the tree must carry its justification (the meta rule
+    fires otherwise and shows up right here)."""
+    findings, n_files = core.lint_paths()
+    offenders = [f.format() for f in findings if not f.suppressed]
+    assert n_files > 70, f"suspiciously small scan: {n_files}"
+    assert not offenders, "tmlint findings in the tree:\n" + \
+        "\n".join(offenders)
+
+
+def test_registry_has_the_advertised_rules():
+    names = set(core.all_rules())
+    assert {"wall", "swallow", "np-load", "donated-escape", "host-sync",
+            "jit-nondet", "exit-code", "import-dag"} <= names
+
+
+# ---------------------------------------------------------------------------
+# suppression grammar
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_requires_justification(tmp_path):
+    active, sup = run_src(
+        tmp_path, "import time\nt = time.time()  # lint: wall-ok\n")
+    assert any(f.rule == "suppression" for f in active)
+    assert any(f.rule == "wall" for f in active)  # bare marker = no effect
+    assert not sup
+
+
+def test_suppression_with_justification_is_recorded_not_silent(tmp_path):
+    active, sup = run_src(
+        tmp_path,
+        "import time\nt = time.time()  # lint: wall-ok — epoch stamp\n")
+    assert not active
+    assert len(sup) == 1 and sup[0].justification == "epoch stamp"
+
+
+def test_suppression_unknown_rule_is_a_finding(tmp_path):
+    active, _ = run_src(
+        tmp_path, "x = 1  # lint: no-such-rule-ok — because\n")
+    assert any(f.rule == "suppression" and "unknown rule" in f.message
+               for f in active)
+
+
+def test_suppression_on_comment_block_above_counts(tmp_path):
+    active, sup = run_src(
+        tmp_path,
+        "import time\n"
+        "# lint: wall-ok — the long call below needs a stamp\n"
+        "t = time.time()\n")
+    assert not active and len(sup) == 1
+
+
+def test_prose_mention_of_the_grammar_is_not_a_marker(tmp_path):
+    """'use lint: wall-ok' mid-comment (or in a docstring) must neither
+    suppress nor trip the meta rule — only a marker STARTING its comment
+    counts (review fix)."""
+    active, sup = run_src(
+        tmp_path,
+        '"""Docs may say lint: wall-ok freely."""\n'
+        "import time\n"
+        "t = time.perf_counter()  # to opt out, use lint: wall-ok\n"
+        "w = time.time()  # silenceable via lint: wall-ok — but not here\n")
+    assert not sup, sup  # the prose on line 4 does NOT suppress the wall hit
+    rules_hit = {f.rule for f in active}
+    assert rules_hit == {"wall"}, active  # and no `suppression` meta noise
+
+
+def test_deselected_rules_still_get_marker_grammar_checks(tmp_path):
+    """`--rules wall` must not hide a broken swallow-ok marker."""
+    path = tmp_path / "fx.py"
+    path.write_text("try:\n    x = 1\nexcept Exception:  "
+                    "# lint: swallow-ok\n    pass\n")
+    findings, _ = core.lint_paths([str(path)], ["wall"],
+                                  root=str(tmp_path))
+    assert any(f.rule == "suppression" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# donated-escape
+# ---------------------------------------------------------------------------
+
+
+def test_donated_escape_fires_on_returned_view(tmp_path):
+    active, _ = run_src(
+        tmp_path,
+        "import numpy as np\ndef f(x):\n    return np.asarray(x)\n",
+        ["donated-escape"])
+    assert len(active) == 1 and active[0].rule == "donated-escape"
+
+
+def test_donated_escape_fires_on_queue_and_thread_handoff(tmp_path):
+    active, _ = run_src(
+        tmp_path,
+        "import numpy as np\n"
+        "def f(q, x, y):\n"
+        "    q.put((1, np.asarray(x)))\n"
+        "    a = np.asarray(y)\n"
+        "    q.put(a)\n",
+        ["donated-escape"])
+    assert len(active) == 2, active
+
+
+def test_donated_escape_respects_copy_and_the_ownership_idiom(tmp_path):
+    active, _ = run_src(
+        tmp_path,
+        "import numpy as np\n"
+        "def direct(x):\n"
+        "    return np.asarray(x).copy()\n"
+        "def wrapped(x):\n"
+        "    return g(np.broadcast_to(np.asarray(x), (2, 3)).copy())\n"
+        "def conditional(v):\n"
+        "    a = np.asarray(v)\n"
+        "    if a.base is not None or not a.flags.owndata:\n"
+        "        a = a.copy()\n"
+        "    return a\n",
+        ["donated-escape"])
+    assert not active, active
+
+
+def test_donated_escape_ignores_consumed_views_and_early_returns(tmp_path):
+    """np.percentile(arr) returns derived data; `return x` ABOVE the
+    rebinding returns the original object (the put_global regression)."""
+    active, _ = run_src(
+        tmp_path,
+        "import numpy as np\n"
+        "def pct(xs):\n"
+        "    arr = np.asarray(xs)\n"
+        "    return float(np.percentile(arr, 50))\n"
+        "def put(x, sharding):\n"
+        "    if ready(x):\n"
+        "        return x\n"
+        "    x = np.asarray(x)\n"
+        "    return device_put(x, sharding)\n",
+        ["donated-escape"])
+    assert not active, active
+
+
+def test_donated_escape_fires_on_attribute_store(tmp_path):
+    active, _ = run_src(
+        tmp_path,
+        "import numpy as np\n"
+        "def f(self, x):\n"
+        "    a = np.asarray(x)\n"
+        "    self.snapshot = a\n",
+        ["donated-escape"])
+    assert len(active) == 1, active
+
+
+# ---------------------------------------------------------------------------
+# host-sync
+# ---------------------------------------------------------------------------
+
+SPAN_SRC = """\
+import numpy as np
+def f(tel, x):
+    with tel.span("train.step"):
+        v = float(x)
+    return v
+def g(tel, x):
+    with tel.span("validate"):
+        acc = []
+        acc.append(x)
+    return float(np.asarray(x).mean())
+def h(tel, x):
+    s = tel.span("decode")
+    with s:
+        return x.item()
+def cond(tel, x, nullcontext):
+    with (tel.span("snap") if tel else nullcontext()):
+        return np.asarray(x)
+"""
+
+
+def test_host_sync_fires_only_inside_spans(tmp_path):
+    active, _ = run_src(tmp_path, SPAN_SRC, ["host-sync"])
+    lines = sorted(f.line for f in active)
+    # f: float inside span (4); g: pulls AFTER the span are clean;
+    # h: .item() under a span-bound name (14); cond: asarray under the
+    # conditional-span idiom (17)
+    assert lines == [4, 14, 17], active
+
+
+def test_host_sync_is_a_warning_and_suppressible(tmp_path):
+    active, sup = run_src(
+        tmp_path,
+        "def f(tel, x):\n"
+        "    with tel.span('serve.prefill'):\n"
+        "        # lint: host-sync-ok — span measures execution by design\n"
+        "        return float(x)\n",
+        ["host-sync"])
+    assert not active and len(sup) == 1
+    assert sup[0].severity == "warning"
+
+
+# ---------------------------------------------------------------------------
+# jit-nondet
+# ---------------------------------------------------------------------------
+
+JIT_SRC = """\
+import time
+import numpy as np
+import jax
+
+@jax.jit
+def decorated(x):
+    return x * time.time()
+
+def _impl(x):
+    return x + np.random.randn()
+
+step = jax.jit(_impl)
+
+def host_side():
+    return time.time()  # wall rule's business, not jit-nondet's
+
+@jax.jit
+def seeded_ok(x):
+    rng = np.random.RandomState(0)
+    return x
+"""
+
+
+def test_jit_nondet_fires_in_jitted_functions_only(tmp_path):
+    active, _ = run_src(tmp_path, JIT_SRC, ["jit-nondet"])
+    lines = sorted(f.line for f in active)
+    assert lines == [7, 10], active  # decorated + jax.jit(_impl) form
+
+
+def test_jit_nondet_guards_the_fault_plan_module(tmp_path):
+    active, _ = run_src(
+        tmp_path,
+        "import numpy as np\n"
+        "def plan():\n"
+        "    return np.random.rand()\n",
+        ["jit-nondet"],
+        rel="theanompi_tpu/resilience/faults.py")
+    assert len(active) == 1 and "fault plan" in active[0].message
+
+
+def test_jit_nondet_flags_unseeded_constructors(tmp_path):
+    active, _ = run_src(
+        tmp_path,
+        "import numpy as np\n"
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    a = np.random.default_rng()\n"
+        "    b = np.random.default_rng(42)\n"
+        "    return x\n",
+        ["jit-nondet"])
+    assert len(active) == 1 and "no seed" in active[0].message
+
+
+# ---------------------------------------------------------------------------
+# exit-code
+# ---------------------------------------------------------------------------
+
+
+def test_exit_code_fires_in_exit_contexts_only(tmp_path):
+    active, _ = run_src(
+        tmp_path,
+        "import sys\n"
+        "def f(rc):\n"
+        "    if rc == 77:\n"
+        "        sys.exit(75)\n"
+        "    raise SystemExit(78)\n"
+        "def not_an_exit_code():\n"
+        "    width = 77\n"
+        "    return width + 75\n",
+        ["exit-code"])
+    lines = sorted(f.line for f in active)
+    assert lines == [3, 4, 5], active
+
+
+def test_exit_code_source_module_is_exempt(tmp_path):
+    active, _ = run_src(
+        tmp_path,
+        "EXIT_PREEMPTED = 75\nassert EXIT_PREEMPTED == 75\n",
+        ["exit-code"],
+        rel="theanompi_tpu/resilience/codes.py")
+    assert not active, active
+
+
+# ---------------------------------------------------------------------------
+# import-dag
+# ---------------------------------------------------------------------------
+
+
+def test_layer_dag_declaration_is_acyclic_by_construction():
+    L.validate_dag()  # raises on forward refs / duplicates
+    # spot-check the load-bearing assignments
+    assert L.module_layer("theanompi_tpu.resilience.codes") == "codes"
+    assert L.module_layer("theanompi_tpu.resilience.faults") == "resilience"
+    assert L.module_layer("theanompi_tpu.telemetry.core") == "telemetry"
+    assert L.module_layer("theanompi_tpu.parallel.mesh") == "mesh"
+    assert L.module_layer("theanompi_tpu.parallel.trainer") == "training"
+    assert L.module_layer("theanompi_tpu.serving.engine") == "serving"
+    assert L.module_layer("theanompi_tpu.launcher") == "tooling"
+    assert L.module_layer("theanompi_tpu.analysis.cli") == "analysis"
+
+
+def test_layer_dag_rejects_forward_references(monkeypatch):
+    bad = (("a", ("theanompi_tpu.a",), ("b",)),
+           ("b", ("theanompi_tpu.b",), ()))
+    monkeypatch.setattr(L, "LAYER_DAG", bad)
+    with pytest.raises(ValueError, match="acyclic"):
+        L.validate_dag()
+
+
+def test_import_dag_flags_module_level_layer_violation(tmp_path):
+    """telemetry is the bottom layer: a module-level mesh import fires."""
+    active, _ = run_src(
+        tmp_path,
+        "from theanompi_tpu.parallel.mesh import DATA_AXIS\n",
+        ["import-dag"],
+        rel="theanompi_tpu/telemetry/bad.py")
+    assert any("leaf subpackage" in f.message or "allowed set" in f.message
+               for f in active), active
+
+
+def test_import_dag_checks_class_body_imports(tmp_path):
+    """A class-body import executes at module import time — it must obey
+    the layering like any top-level import (review fix)."""
+    active, _ = run_src(
+        tmp_path,
+        "class Sneaky:\n"
+        "    from theanompi_tpu.parallel.mesh import DATA_AXIS\n",
+        ["import-dag"],
+        rel="theanompi_tpu/telemetry/bad.py")
+    assert active, "class-body import-time dependency not checked"
+
+
+def test_import_dag_allows_lazy_cycle_breaking_imports(tmp_path):
+    """A function-local upward import is a deliberate lazy edge (the
+    ops/opt.py idiom) — layering ignores it; only walls check deep."""
+    active, _ = run_src(
+        tmp_path,
+        "def late():\n"
+        "    from theanompi_tpu.parallel.trainer import BaseTrainer\n"
+        "    return BaseTrainer\n",
+        ["import-dag"],
+        rel="theanompi_tpu/models/helper.py")
+    assert not active, active
+
+
+def test_import_dag_wall_catches_lazy_serving_import(tmp_path):
+    active, _ = run_src(
+        tmp_path,
+        "def late():\n"
+        "    from theanompi_tpu.parallel import exchanger\n"
+        "    return exchanger\n",
+        ["import-dag"],
+        rel="theanompi_tpu/serving/bad.py")
+    assert any("training machinery" in f.message for f in active), active
+
+
+# ---------------------------------------------------------------------------
+# CLI exit contract + JSON report schema
+# ---------------------------------------------------------------------------
+
+import os
+
+VIOLATION_FIXTURE = os.path.join(core.REPO_ROOT, "tests", "fixtures",
+                                 "tmlint_violation.py")
+
+
+def test_cli_exits_nonzero_on_the_seeded_violation_file(capsys):
+    rc = cli.main([VIOLATION_FIXTURE])
+    out = capsys.readouterr().out
+    assert rc == 1
+    for rule in ("wall", "swallow", "np-load", "donated-escape",
+                 "exit-code", "suppression"):
+        assert f"[{rule}]" in out, f"seeded {rule} violation not caught"
+
+
+def test_cli_exit_contract(tmp_path, capsys):
+    assert cli.main(["--rules", "bogus"]) == 2
+    err = capsys.readouterr().err
+    assert err.startswith("tmlint: error:") and err.count("\n") == 1
+
+    assert cli.main([str(tmp_path / "missing.py")]) == 2
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert cli.main([str(clean)]) == 0
+
+    assert cli.main(["--no-such-flag"]) == 2  # argparse's own contract
+
+
+def test_cli_report_schema(tmp_path, capsys):
+    """The JSON artifact schema the runbook step publishes (LINT.json):
+    version/tool/summary + per-finding keys, suppressed entries carrying
+    their justification."""
+    report_path = tmp_path / "LINT.json"
+    rc = cli.main([VIOLATION_FIXTURE, "--report", str(report_path),
+                   "--quiet"])
+    assert rc == 1
+    rep = json.loads(report_path.read_text())
+    assert rep["version"] == 1 and rep["tool"] == "tmlint"
+    assert rep["files_scanned"] == 1
+    assert {r["name"] for r in rep["rules"]} == set(core.all_rules())
+    for r in rep["rules"]:
+        assert set(r) == {"name", "severity", "description"}
+    assert rep["findings"], "seeded violations missing from the report"
+    for f in rep["findings"]:
+        assert set(f) == {"rule", "severity", "path", "line", "col",
+                          "message", "suppressed"}
+        assert f["suppressed"] is False
+        assert isinstance(f["line"], int) and f["line"] > 0
+    for f in rep["suppressed"]:
+        assert f["suppressed"] is True and f["justification"]
+    s = rep["summary"]
+    assert s["errors"] == sum(f["severity"] == "error"
+                              for f in rep["findings"])
+    assert s["suppressed"] == len(rep["suppressed"])
+
+
+def test_cli_clean_package_report(tmp_path):
+    """tmlint over the package writes a findings-free report and exits 0
+    — the exact runbook invocation (BASELINE.md)."""
+    report_path = tmp_path / "LINT.json"
+    rc = cli.main(["--report", str(report_path), "--quiet"])
+    assert rc == 0
+    rep = json.loads(report_path.read_text())
+    assert rep["findings"] == []
+    assert rep["summary"]["errors"] == 0
+    assert rep["summary"]["suppressed"] > 0  # justified markers, visible

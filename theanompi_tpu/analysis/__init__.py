@@ -1,0 +1,27 @@
+"""theanompi_tpu.analysis — the ``tmlint`` static-analysis subsystem.
+
+Two halves:
+
+- **AST rules** (:mod:`.core`, :mod:`.rules`, :mod:`.layers`): a rule
+  registry run over one shared parse per file — wall-clock discipline,
+  exception swallowing, np.load confinement, donated-buffer escapes,
+  host syncs in spans, jit nondeterminism, exit-code literals, and the
+  declared package-layer DAG.  Console script: ``tmlint``.
+- **Compiled-artifact audit** (:mod:`.hlo_audit`): jit representative
+  train/serve steps and assert what the AST cannot see — donation
+  actually applied, the PR 2 collective-count lock, no host callbacks
+  in the HLO.
+
+Import surface is deliberately lazy: ``from theanompi_tpu.analysis import
+core`` pulls no jax; only ``hlo_audit`` needs a backend.
+"""
+
+from theanompi_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Rule,
+    all_rules,
+    build_report,
+    default_paths,
+    lint_paths,
+    register,
+)

@@ -1,0 +1,6 @@
+"""``python -m theanompi_tpu.analysis`` == ``tmlint``."""
+
+from theanompi_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,641 @@
+"""tmlint rules: the bug classes this repo has actually hit.
+
+Three rules are straight ports of the PR 1/4/5 test lints (``wall``,
+``swallow``, ``np-load``); four are new, distilled from the repo's own
+incident history:
+
+- ``donated-escape`` — PR 5's latent async-writer race: ``np.asarray`` on
+  a jax array is ZERO-COPY on the CPU backend, so a view that crosses a
+  return/thread/queue boundary aliases a buffer the next donated step
+  will rewrite underneath the reader (torn .npz, flaky CRC).
+- ``host-sync`` — PR 2's hoisting lesson: ``float()``/``bool()``/
+  ``np.asarray``/``.item()`` on device values inside a telemetry span
+  forces a device sync inside the timed region, so the span measures the
+  sync it caused.
+- ``jit-nondet`` — wall clocks and global RNG inside a jitted function
+  burn a trace-time constant into the executable (different on every
+  recompile, invisible at runtime); in the fault plan they break the
+  PR 4 determinism contract outright.
+- ``exit-code`` — PR 4's exit-code drift: bare 70/75/76/77/78 literals
+  outside ``resilience/codes.py`` re-create the duplicated contract that
+  module exists to kill.
+
+Every rule is heuristic where it must be (static analysis cannot prove a
+buffer is donated); the escape hatch is the suppression grammar in
+:mod:`theanompi_tpu.analysis.core` — inline, justified, reported.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from theanompi_tpu.analysis.core import (
+    SEV_ERROR,
+    SEV_WARNING,
+    Finding,
+    Rule,
+    SourceFile,
+    register,
+)
+
+# ---------------------------------------------------------------------------
+# ports of the legacy test lints
+# ---------------------------------------------------------------------------
+
+
+@register
+class WallClockRule(Rule):
+    """``time.time()`` in package code (PR 1's timing lint).
+
+    Durations must come from ``time.perf_counter()`` — ``time.time()`` is
+    NTP-steppable and low-resolution.  Wall-clock *stamps* (run ids,
+    heartbeat payloads, audit records) mark the line ``lint: wall-ok``
+    with the reason wall time is genuinely required.
+    """
+
+    name = "wall"
+    severity = SEV_ERROR
+    description = ("time.time() in timed paths — use time.perf_counter() "
+                   "for durations")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        for node in ast.walk(src.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "time"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "time"):
+                yield self.finding(
+                    src, node.lineno, node.col_offset,
+                    "time.time() — durations use time.perf_counter(); a "
+                    "genuine wall-clock stamp marks the line 'lint: "
+                    "wall-ok — <why>'")
+
+
+#: (repo-relative path, enclosing function) pairs exempt from the broad-
+#: handler check — the documented correlated-failure teardown sites plus
+#: the CLI mains whose whole job is the exit-code contract
+SWALLOW_ALLOWLIST = {
+    ("theanompi_tpu/parallel/trainer.py", "run"),    # teardown join
+    ("theanompi_tpu/parallel/trainer.py", "wait"),   # telemetry finalize
+    ("theanompi_tpu/launcher.py", "main"),           # exit-code contract
+    ("theanompi_tpu/serving/cli.py", "main"),        # tmserve contract
+    ("theanompi_tpu/analysis/cli.py", "main"),       # tmlint contract
+}
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(type_node) -> bool:
+    if type_node is None:
+        return True
+    nodes = type_node.elts if isinstance(type_node, ast.Tuple) else [type_node]
+    return any(isinstance(n, ast.Name) and n.id in _BROAD for n in nodes)
+
+
+def _stashes_error(handler: ast.ExceptHandler) -> bool:
+    """Deferred-delivery pattern: the caught error is assigned somewhere
+    (``self._err = e``) for a later re-raise at the consuming site."""
+    if not handler.name:
+        return False
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Assign):
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name) and sub.id == handler.name:
+                    return True
+    return False
+
+
+@register
+class SwallowRule(Rule):
+    """Exception swallowing in package error paths (PR 4's lint).
+
+    The resilience layer only works if failures PROPAGATE: flags bare
+    ``except:``, pass-only handler bodies, and broad handlers
+    (``Exception``/``BaseException``) that neither re-raise nor stash the
+    error for deferred delivery.  The marker counts on the ``except``
+    line or the first body line (the PR 4 placement).
+    """
+
+    name = "swallow"
+    severity = SEV_ERROR
+    description = ("bare/pass-only/broad exception handlers swallow "
+                   "failures the resilience layer needs")
+
+    def _enclosing_function(self, src: SourceFile,
+                            handler: ast.ExceptHandler) -> str:
+        for anc in src.ancestors(handler):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc.name
+        return "<module>"
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            marker_lines = (node.body[0].lineno,) if node.body else ()
+            if node.type is None:
+                yield self.finding(
+                    src, node.lineno, node.col_offset,
+                    "bare `except:` catches everything, SystemExit "
+                    "included", marker_lines)
+                continue
+            body_is_pass = (len(node.body) == 1
+                            and isinstance(node.body[0], ast.Pass))
+            if body_is_pass:
+                yield self.finding(
+                    src, node.lineno, node.col_offset,
+                    "handler body is only `pass` — the classic swallow",
+                    marker_lines)
+                continue
+            has_raise = any(isinstance(n, ast.Raise) for n in ast.walk(node))
+            if (_is_broad(node.type) and not has_raise
+                    and not _stashes_error(node)
+                    and (src.rel, self._enclosing_function(src, node))
+                    not in SWALLOW_ALLOWLIST):
+                yield self.finding(
+                    src, node.lineno, node.col_offset,
+                    "broad handler swallows the error (no raise / no "
+                    "deferred stash)", marker_lines)
+
+
+#: files allowed to call np.load (PR 5's lint): checkpoint ``.npz`` bytes
+#: must only be read through the verified loader — dataset shards and
+#: recorder histories have their own (non-checkpoint) formats.  Serving
+#: must NEVER appear here (read-only consumers go through
+#: ``load_for_inference``).
+NP_LOAD_ALLOWED_PREFIXES = (
+    "theanompi_tpu/utils/checkpoint.py",   # THE verified loader
+    "theanompi_tpu/utils/recorder.py",     # history .npy snapshots
+    "theanompi_tpu/models/data/",          # dataset shard reads
+)
+
+
+@register
+class NpLoadRule(Rule):
+    """``np.load`` outside the verified-loader allowlist (PR 5's lint).
+
+    A ``np.load(ckpt_path)`` anywhere else bypasses manifest
+    verification, the fingerprint check and the recovery chain.
+    """
+
+    name = "np-load"
+    severity = SEV_ERROR
+    description = ("np.load confined to the verified checkpoint loader / "
+                   "recorder / dataset allowlist")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        if src.rel.startswith(NP_LOAD_ALLOWED_PREFIXES):
+            return
+        for node in ast.walk(src.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "load"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in ("np", "numpy")):
+                yield self.finding(
+                    src, node.lineno, node.col_offset,
+                    "np.load outside the verified checkpoint loader "
+                    "allowlist — go through theanompi_tpu.utils.checkpoint")
+
+
+# ---------------------------------------------------------------------------
+# donated-buffer escape (the PR 5 async-writer race class)
+# ---------------------------------------------------------------------------
+
+_ESCAPE_CALL_ATTRS = {"put", "put_nowait", "submit"}
+
+
+def _is_np_asarray(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "asarray"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in ("np", "numpy"))
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else "")
+    return name == "Thread"
+
+
+@register
+class DonatedEscapeRule(Rule):
+    """``np.asarray`` view escaping a return/thread/queue boundary.
+
+    ``np.asarray`` on a jax array is zero-copy on the CPU backend: the
+    numpy view aliases the device buffer, and if that buffer is later
+    donated (``donate_argnums``) the next step rewrites the bytes under
+    whoever kept the view — PR 5's torn-.npz race, rediscovered by CRC.
+    Flags an ``np.asarray(...)`` whose result (directly or via a local
+    name) is returned/yielded, handed to ``queue.put``/``executor.submit``
+    / a ``Thread``, stored on ``self`` or into a container — unless a
+    ``.copy()`` breaks the aliasing anywhere along the way.
+    """
+
+    name = "donated-escape"
+    severity = SEV_ERROR
+    description = ("np.asarray zero-copy view of a (possibly donated) "
+                   "device buffer escapes without .copy()")
+
+    def _sanitized(self, src: SourceFile, node: ast.AST) -> bool:
+        """A `.copy()` call wraps ``node`` somewhere up the expression."""
+        for anc in src.ancestors(node):
+            if (isinstance(anc, ast.Call)
+                    and isinstance(anc.func, ast.Attribute)
+                    and anc.func.attr == "copy"):
+                return True
+            if isinstance(anc, ast.stmt):
+                return False
+        return False
+
+    def _escape_reason(self, src: SourceFile, node: ast.AST) -> str | None:
+        """Why ``node``'s value leaves the function, or None.
+
+        Walks up through container displays (a tuple/list/dict keeps the
+        view alive verbatim) but stops at an ordinary call — a function
+        consuming the view (``np.percentile(arr)``, ``device_put(x)``)
+        returns derived data, not the alias.  Queue/executor/thread calls
+        are the exception: they hand the object itself across a thread
+        boundary, which is exactly the PR 5 race shape.
+        """
+        for anc in src.ancestors(node):
+            if isinstance(anc, (ast.Return, ast.Yield, ast.YieldFrom)):
+                return "returned"
+            if isinstance(anc, ast.Call):
+                if (isinstance(anc.func, ast.Attribute)
+                        and anc.func.attr in _ESCAPE_CALL_ATTRS):
+                    return f"passed to .{anc.func.attr}()"
+                if _is_thread_ctor(anc):
+                    return "passed to a Thread"
+                return None  # consumed by an ordinary call
+            if isinstance(anc, (ast.BinOp, ast.UnaryOp, ast.Compare)):
+                return None  # arithmetic/comparison yields derived data
+            if isinstance(anc, ast.stmt):
+                return None
+            # containers, conditionals, attribute/subscript views: the
+            # alias survives — keep walking up
+        return None
+
+    def _name_sanitized(self, fn: ast.AST, name: str) -> bool:
+        """``name.copy()`` appears anywhere in the function (accepts the
+        conditional ``a = a.copy()`` ownership-check idiom)."""
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "copy"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == name):
+                return True
+        return False
+
+    def _name_escapes(self, src: SourceFile, fn: ast.AST, name: str,
+                      bound_line: int) -> tuple[int, str] | None:
+        """(line, reason) where the bound name leaves the function.
+
+        Loads on lines before the binding are ignored — an early ``return
+        x`` guard above a later ``x = np.asarray(x)`` rebinding returns
+        the ORIGINAL object, not the view (flow-insensitivity fix).
+        """
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Name) and node.id == name
+                    and isinstance(node.ctx, ast.Load)
+                    and node.lineno >= bound_line):
+                continue
+            reason = self._escape_reason(src, node)
+            if reason is not None and not self._sanitized(src, node):
+                return node.lineno, reason
+            parent = src.parent_map().get(node)
+            if isinstance(parent, ast.Assign) and node is parent.value:
+                for tgt in parent.targets:
+                    if isinstance(tgt, ast.Attribute):
+                        return node.lineno, "stored on an attribute"
+                    if isinstance(tgt, ast.Subscript):
+                        return node.lineno, "stored into a container"
+        return None
+
+    def _nearest_function(self, src: SourceFile, node: ast.AST) -> ast.AST | None:
+        for anc in src.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return anc
+        return None
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        for fn in ast.walk(src.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                continue
+            for node in ast.walk(fn):
+                if not _is_np_asarray(node):
+                    continue
+                # nested defs are walked once, in their OWN scope (name
+                # tracking below is per-function)
+                if self._nearest_function(src, node) is not fn:
+                    continue
+                if self._sanitized(src, node):
+                    continue
+                reason = self._escape_reason(src, node)
+                if reason is None:
+                    # value bound to a simple local name? track the name
+                    parent = src.parent_map().get(node)
+                    while isinstance(parent, ast.IfExp):
+                        parent = src.parent_map().get(parent)
+                    if (isinstance(parent, ast.Assign)
+                            and len(parent.targets) == 1
+                            and isinstance(parent.targets[0], ast.Name)):
+                        bound = parent.targets[0].id
+                        if not self._name_sanitized(fn, bound):
+                            hit = self._name_escapes(src, fn, bound,
+                                                     node.lineno)
+                            if hit is not None:
+                                line, why = hit
+                                yield self.finding(
+                                    src, node.lineno, node.col_offset,
+                                    f"np.asarray view bound to "
+                                    f"{bound!r} is {why} at line {line} "
+                                    f"without .copy() — a donated buffer "
+                                    f"would be rewritten under the reader")
+                    continue
+                yield self.finding(
+                    src, node.lineno, node.col_offset,
+                    f"np.asarray view {reason} without .copy() — a "
+                    f"donated buffer would be rewritten under the reader")
+
+
+# ---------------------------------------------------------------------------
+# host-sync inside telemetry spans
+# ---------------------------------------------------------------------------
+
+
+def _is_span_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "span")
+
+
+def _span_in_expr(expr: ast.AST) -> bool:
+    """Does this with-item expression produce a telemetry span?  Handles
+    the repo's ``with (tel.span(...) if tel else nullcontext()):`` idiom."""
+    return any(_is_span_call(n) for n in ast.walk(expr))
+
+
+@register
+class HostSyncRule(Rule):
+    """Device sync inside a telemetry span (the timed-path bug class).
+
+    ``float()``/``bool()``/``np.asarray()``/``.item()`` on a device value
+    blocks on the device INSIDE the span, so the span times the stall it
+    created (PR 2 hoisted exactly these out of the step path).  A span
+    that deliberately closes over materialized results — the documented
+    "measure execution, not dispatch" pattern — marks the line
+    ``lint: host-sync-ok — <why>``.
+    """
+
+    name = "host-sync"
+    severity = SEV_WARNING
+    description = ("float()/bool()/np.asarray/.item() inside a telemetry "
+                   "span forces a device sync into the timed region")
+
+    def _span_bound_names(self, fn: ast.AST) -> set[str]:
+        """Local names assigned a span (``span = tel.span(...)``)."""
+        names: set[str] = set()
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and _span_in_expr(node.value)):
+                names.add(node.targets[0].id)
+        return names
+
+    def _sync_calls(self, body: list[ast.stmt]) -> Iterator[ast.Call]:
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if (isinstance(f, ast.Name) and f.id in ("float", "bool")
+                        and len(node.args) == 1
+                        and not isinstance(node.args[0], ast.Constant)):
+                    yield node
+                elif _is_np_asarray(node):
+                    yield node
+                elif isinstance(f, ast.Attribute) and f.attr == "item":
+                    yield node
+
+    def _enclosing_span_names(self, src: SourceFile,
+                              node: ast.AST) -> set[str]:
+        """Span-bound local names visible at ``node`` (its enclosing
+        function's assignments, or the module's for top-level code)."""
+        for anc in src.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return self._span_bound_names(anc)
+        return self._span_bound_names(src.tree)
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        seen: set[int] = set()
+        for with_node in ast.walk(src.tree):
+            if not isinstance(with_node, (ast.With, ast.AsyncWith)):
+                continue
+            spanned = any(
+                _span_in_expr(item.context_expr)
+                or (isinstance(item.context_expr, ast.Name)
+                    and item.context_expr.id
+                    in self._enclosing_span_names(src, with_node))
+                for item in with_node.items)
+            if not spanned:
+                continue
+            for call in self._sync_calls(with_node.body):
+                if id(call) in seen:
+                    continue
+                seen.add(id(call))
+                yield self.finding(
+                    src, call.lineno, call.col_offset,
+                    "host sync inside a telemetry span — the span times "
+                    "the stall it causes; hoist the pull past the span, "
+                    "or mark 'lint: host-sync-ok — <why>' if the span "
+                    "deliberately measures execution")
+
+
+# ---------------------------------------------------------------------------
+# untracked nondeterminism under jit / in the fault plan
+# ---------------------------------------------------------------------------
+
+#: files whose WHOLE body must stay deterministic (the PR 4 fault plan:
+#: `site:action@index[@attempt]` replays bit-exactly across restarts)
+DETERMINISTIC_FILES = (
+    "theanompi_tpu/resilience/faults.py",
+)
+
+_NONDET_TIME = {"time", "time_ns"}
+_NONDET_DATETIME = {"now", "today", "utcnow"}
+#: np.random module-level entry points that are fine — seeded constructors
+_NP_RANDOM_OK = {"RandomState", "default_rng", "Generator", "SeedSequence",
+                 "PCG64", "Philox"}
+
+
+def _jit_marked(expr: ast.AST) -> bool:
+    """Does this expression mention a ``jit`` callable (jax.jit, jit,
+    partial(jax.jit, ...))?"""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr == "jit":
+            return True
+        if isinstance(node, ast.Name) and node.id == "jit":
+            return True
+    return False
+
+
+@register
+class JitNondetRule(Rule):
+    """Nondeterminism burned into a jitted trace or the fault plan.
+
+    Inside a function that gets jitted, ``time.time()``, global
+    ``np.random.*`` and ``datetime.now()`` run at TRACE time: the value
+    becomes a compile-time constant that silently changes on every
+    recompile.  In :mod:`theanompi_tpu.resilience.faults` the same calls
+    break the deterministic-replay contract outright.
+    """
+
+    name = "jit-nondet"
+    severity = SEV_ERROR
+    description = ("wall clock / global RNG in jitted or fault-plan-"
+                   "deterministic code")
+
+    def _jitted_functions(self, src: SourceFile) -> list[ast.AST]:
+        """FunctionDefs that are jit-decorated, or whose name is passed
+        to a ``jit(...)`` call anywhere in the file (covers the
+        ``self._fn = jax.jit(self._impl, ...)`` idiom)."""
+        defs: dict[str, list[ast.AST]] = {}
+        jitted: list[ast.AST] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+                if any(_jit_marked(d) for d in node.decorator_list):
+                    jitted.append(node)
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call) and _jit_marked(node.func)):
+                continue
+            for arg in node.args[:1]:
+                name = (arg.id if isinstance(arg, ast.Name)
+                        else arg.attr if isinstance(arg, ast.Attribute)
+                        else None)
+                if name:
+                    jitted.extend(defs.get(name, ()))
+        return jitted
+
+    def _nondet_calls(self, scope: ast.AST, has_bare_random: bool,
+                      ) -> Iterator[tuple[ast.Call, str]]:
+        for node in ast.walk(scope):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            f = node.func
+            v = f.value
+            if (isinstance(v, ast.Name) and v.id == "time"
+                    and f.attr in _NONDET_TIME):
+                yield node, f"time.{f.attr}()"
+            elif (f.attr in _NONDET_DATETIME
+                  and isinstance(v, ast.Name) and v.id == "datetime"):
+                yield node, f"datetime.{f.attr}()"
+            elif (f.attr in _NONDET_DATETIME
+                  and isinstance(v, ast.Attribute) and v.attr == "datetime"):
+                yield node, f"datetime.datetime.{f.attr}()"
+            elif (isinstance(v, ast.Attribute) and v.attr == "random"
+                  and isinstance(v.value, ast.Name)
+                  and v.value.id in ("np", "numpy")):
+                if f.attr not in _NP_RANDOM_OK:
+                    yield node, f"np.random.{f.attr}()"
+                elif not node.args and not node.keywords:
+                    yield node, f"np.random.{f.attr}() with no seed"
+            elif (has_bare_random and isinstance(v, ast.Name)
+                  and v.id == "random" and f.attr != "seed"):
+                yield node, f"random.{f.attr}()"
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        has_bare_random = any(
+            isinstance(n, ast.Import)
+            and any(a.name == "random" for a in n.names)
+            for n in ast.walk(src.tree))
+        scopes: list[tuple[ast.AST, str]] = []
+        if src.rel in DETERMINISTIC_FILES:
+            scopes.append((src.tree, "the deterministic fault plan"))
+        else:
+            scopes.extend((fn, f"jitted function {fn.name!r}")
+                          for fn in self._jitted_functions(src))
+        seen: set[int] = set()
+        for scope, where in scopes:
+            for call, what in self._nondet_calls(scope, has_bare_random):
+                if id(call) in seen:
+                    continue
+                seen.add(id(call))
+                yield self.finding(
+                    src, call.lineno, call.col_offset,
+                    f"{what} inside {where} — the value is nondeterministic"
+                    f" (trace-time constant under jit); thread it in as an"
+                    f" argument instead")
+
+
+# ---------------------------------------------------------------------------
+# exit-code literals
+# ---------------------------------------------------------------------------
+
+#: the codes the contract in resilience/codes.py owns (EXIT_CLEAN=0 and
+#: argparse's 2 are universal; flagging them would drown the rule in noise)
+EXIT_CODE_LITERALS = {70, 75, 76, 77, 78}
+EXIT_CODES_SOURCE = "theanompi_tpu/resilience/codes.py"
+
+_EXIT_CALL_NAMES = {"exit", "SystemExit", "_exit"}
+
+
+@register
+class ExitCodeRule(Rule):
+    """Bare exit-code literals outside ``resilience/codes.py``.
+
+    A literal ``77`` in a ``sys.exit``/``SystemExit``/comparison is a
+    drifted duplicate of the contract waiting to happen (PR 4 created
+    ``codes.py`` precisely because two halves of the resilience layer
+    must agree).  Import the named constant instead.
+    """
+
+    name = "exit-code"
+    severity = SEV_ERROR
+    description = ("bare 70/75/76/77/78 exit-code literal — import from "
+                   "theanompi_tpu.resilience.codes")
+
+    def _literals_in(self, node: ast.AST) -> Iterator[ast.Constant]:
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Constant)
+                    and type(sub.value) is int
+                    and sub.value in EXIT_CODE_LITERALS):
+                yield sub
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        if src.rel == EXIT_CODES_SOURCE:
+            return
+        flagged: set[int] = set()
+
+        def emit(const: ast.Constant, ctx: str):
+            if id(const) in flagged:
+                return
+            flagged.add(id(const))
+            yield self.finding(
+                src, const.lineno, const.col_offset,
+                f"bare exit-code literal {const.value} in {ctx} — use the "
+                f"named constant from theanompi_tpu.resilience.codes")
+
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                f = node.func
+                name = (f.attr if isinstance(f, ast.Attribute)
+                        else f.id if isinstance(f, ast.Name) else "")
+                if name in _EXIT_CALL_NAMES:
+                    for arg in node.args:
+                        for const in self._literals_in(arg):
+                            yield from emit(const, f"{name}()")
+            elif isinstance(node, ast.Compare):
+                for side in (node.left, *node.comparators):
+                    for const in self._literals_in(side):
+                        yield from emit(const, "a comparison")

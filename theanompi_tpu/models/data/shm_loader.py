@@ -56,6 +56,8 @@ def _worker(task_q, result_q, shm_name, slot_nbytes, image_size):
             out = np.ndarray(x.shape, np.uint8,
                              buffer=shm.buf[slot * slot_nbytes:])
             out[:] = x
+            # lint: donated-escape-ok — y is fancy-indexed above (y[per]):
+            # a fresh host-owned array, never a device-buffer view
             result_q.put((idx, slot, x.shape, np.asarray(y)))
     finally:
         shm.close()

@@ -114,8 +114,8 @@ def setup_compile_cache(directory: str | None = None,
         from jax._src import compilation_cache
 
         compilation_cache.reset_cache()
-    except Exception:  # lint: swallow-ok
-        pass  # private surface: a moved symbol must not break the launcher
+    except Exception:  # lint: swallow-ok — private jax surface; a moved
+        pass  # symbol must not break the launcher's cache-flip best effort
     return directory
 
 

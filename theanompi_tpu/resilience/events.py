@@ -53,8 +53,8 @@ def record_event(path: str, name: str, **fields) -> None:
     events = data.setdefault("events", [])
     # wall-clock stamp, not a duration: this is an audit record a human
     # correlates with scheduler logs
-    events.append({"ts": time.time(), "name": name,  # lint: wall-ok
-                   **fields})
+    events.append({"ts": time.time(), "name": name,  # lint: wall-ok — audit
+                   **fields})           # stamp humans correlate with logs
     # per-writer tmp name: the scrubber CLI may quarantine against a
     # directory whose live writer thread is scrubbing too — a shared
     # ".tmp" would let one writer publish the other's half-written file.

@@ -223,7 +223,7 @@ class Supervisor:
                 try:
                     os.remove(self.heartbeat_path)  # stale mtime = insta-kill
                 except OSError:
-                    pass  # lint: swallow-ok
+                    pass  # lint: swallow-ok — heartbeat already absent
             cmd = self._attempt_cmd(attempt)
             self._log(f"attempt {attempt}: {' '.join(cmd)}")
             t0 = time.perf_counter()
@@ -351,8 +351,8 @@ class Supervisor:
             return
         try:
             os.makedirs(self.telemetry_dir, exist_ok=True)
-            line = json.dumps({"ts": time.time(),  # lint: wall-ok
-                               "kind": "instant", **event})
+            line = json.dumps({"ts": time.time(),  # lint: wall-ok — log
+                               "kind": "instant", **event})  # stamp
             with open(os.path.join(self.telemetry_dir,
                                    "supervisor.jsonl"), "a") as f:
                 f.write(line + "\n")

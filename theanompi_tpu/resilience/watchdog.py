@@ -54,13 +54,16 @@ class Heartbeat:
                 # wall time: the supervisor compares against ITS clock via
                 # the file mtime, and the payload is for humans
                 json.dump({"step": int(step), "pid": os.getpid(),
-                           "time": time.time()}, f)  # lint: wall-ok
+                           # lint: wall-ok — supervisor reads the file
+                           # MTIME; this payload copy is for humans
+                           "time": time.time()}, f)
             os.replace(tmp, self.path)  # a reader never sees a torn write
         except OSError:
             # a full disk must degrade the heartbeat, not kill training;
             # the supervisor's mtime backstop goes stale, which is the
             # honest signal for "this host can no longer prove liveness"
-            pass  # lint: swallow-ok
+            pass  # lint: swallow-ok — full disk degrades the heartbeat;
+            #       the stale-mtime backstop is the honest signal
 
 
 def heartbeat_age_s(path: str) -> float | None:
@@ -70,7 +73,8 @@ def heartbeat_age_s(path: str) -> float | None:
         st = os.stat(path)
     except OSError:
         return None
-    return max(0.0, time.time() - st.st_mtime)  # lint: wall-ok
+    # lint: wall-ok — mtime is wall time; the age must use the same clock
+    return max(0.0, time.time() - st.st_mtime)
 
 
 class Watchdog:

@@ -165,6 +165,8 @@ class InferenceEngine:
             jnp.asarray(p, jnp.int32),
             jnp.asarray(temperature, jnp.float32),
             jnp.asarray(rid, jnp.int32), self._base_key)
+        # lint: donated-escape-ok — prefill outputs are fresh XLA result
+        # buffers; only the k/v pools are donated, never sampled tokens
         return int(nxt), np.asarray(last)
 
     def decode(self, tables, lengths, tokens, temps, rids):
@@ -179,6 +181,8 @@ class InferenceEngine:
             jnp.asarray(tokens, jnp.int32),
             jnp.asarray(temps, jnp.float32),
             jnp.asarray(rids, jnp.int32), self._base_key)
+        # lint: donated-escape-ok — decode outputs are fresh XLA result
+        # buffers; only the k/v pools are donated, never tokens/logits
         return np.asarray(nxt), np.asarray(logits)
 
     def fence(self):

@@ -117,12 +117,15 @@ def _to_host(leaf) -> np.ndarray:
     if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
         if leaf.is_fully_replicated:
             # every device holds the whole value; read a local shard
+            # lint: donated-escape-ok — staging view BY DESIGN: _snapshot
+            # copies any non-owning array before the writer thread starts
             return np.asarray(leaf.addressable_shards[0].data)
         # multi-host pod, cross-host-sharded leaf: gather the global value
         # (a collective — every process must reach this point)
         from jax.experimental import multihost_utils
 
         leaf = multihost_utils.process_allgather(leaf, tiled=True)
+    # lint: donated-escape-ok — staging view BY DESIGN; _snapshot copies
     return np.asarray(leaf)
 
 
@@ -430,8 +433,8 @@ class Checkpointer:
                     or f.endswith(".manifest.json.tmp")):
                 try:
                     os.remove(os.path.join(self.directory, f))
-                except OSError:  # lint: swallow-ok
-                    pass  # concurrent cleanup / permissions: not fatal
+                except OSError:  # lint: swallow-ok — concurrent cleanup /
+                    pass  # permissions: the debris sweep is best-effort
         for f in os.listdir(self.directory):
             if not f.endswith(".manifest.json"):
                 continue
@@ -439,8 +442,8 @@ class Checkpointer:
             if not os.path.exists(os.path.join(self.directory, npz)):
                 try:
                     os.remove(os.path.join(self.directory, f))
-                except OSError:  # lint: swallow-ok
-                    pass  # same best-effort contract as above
+                except OSError:  # lint: swallow-ok — same best-effort
+                    pass  # debris-sweep contract as above
 
     def _path(self, epoch: int) -> str:
         return os.path.join(self.directory, f"ckpt_e{epoch:04d}.npz")

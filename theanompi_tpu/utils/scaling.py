@@ -123,7 +123,7 @@ def _have_xplane_protos() -> bool:
     try:
         return importlib.util.find_spec(
             "tensorflow.tsl.profiler.protobuf.xplane_pb2") is not None
-    except Exception:  # lint: swallow-ok
+    except Exception:  # lint: swallow-ok — degrade to null comm_share
         # the intent is "null comm_share instead of crashing" on ANY broken
         # tensorflow install — find_spec can raise more than ImportError
         # (e.g. a protobuf version mismatch during package init, ADVICE r4)
