@@ -230,6 +230,39 @@ def test_runbook_checkpoint_scrubber_command(tmp_path, capsys):
     assert "CORRUPT" in capsys.readouterr().out
 
 
+def test_runbook_reshard_plan_command(tmp_path, capsys):
+    """The RUNBOOK's elastic-resume dry run (ISSUE 8): the exact
+    `python -m theanompi_tpu.utils.checkpoint --reshard-plan DIR
+    --to-devices N` invocation must plan a topology transition from the
+    manifest alone and exit 0 plannable / 79 refused."""
+    import numpy as np
+
+    from theanompi_tpu.resilience import EXIT_RESHARD
+    from theanompi_tpu.utils import checkpoint as ck_mod
+
+    d = str(tmp_path / "ckpt")
+    ck = ck_mod.Checkpointer(d, fingerprint={
+        "mesh": {"data": 16, "pipe": 1, "model": 1, "seq": 1},
+        "exchange": "zero1", "n_subb": 1,
+        "model": "ResNet50", "model_config_sha": "deadbeef"})
+    ck.save(0, 40, {
+        "params": {"w": np.zeros((30,), np.float32)},
+        "opt_state": {"velocity": [np.zeros((32,), np.float32)]}})
+    ck.mark_clean()
+    assert ck_mod.main(["--reshard-plan", d, "--to-devices", "8"]) == 0
+    out = capsys.readouterr().out
+    assert "reshard plan: 16 -> 8 workers" in out
+    assert "LR x0.5" in out and "plannable" in out
+    # an unplannable transition flips to the contract's reshard code
+    assert ck_mod.main(["--reshard-plan", d, "--to-devices", "8",
+                        "--strategy", "psum"]) == EXIT_RESHARD
+    assert "REFUSED" in capsys.readouterr().out
+    # the launcher accepts the runbook's --elastic spelling
+    args = launcher.build_parser().parse_args(
+        ["--elastic", "--devices", "all"])
+    assert args.elastic
+
+
 def test_runbook_tmlint_command(tmp_path, capsys):
     """The RUNBOOK's static-analysis gate (ISSUE 7): the exact
     `python -m theanompi_tpu.analysis --report LINT.json` invocation must

@@ -16,7 +16,7 @@ incident history:
   burn a trace-time constant into the executable (different on every
   recompile, invisible at runtime); in the fault plan they break the
   PR 4 determinism contract outright.
-- ``exit-code`` — PR 4's exit-code drift: bare 70/75/76/77/78 literals
+- ``exit-code`` — PR 4's exit-code drift: bare 70/75/76/77/78/79 literals
   outside ``resilience/codes.py`` re-create the duplicated contract that
   module exists to kill.
 
@@ -584,7 +584,7 @@ class JitNondetRule(Rule):
 
 #: the codes the contract in resilience/codes.py owns (EXIT_CLEAN=0 and
 #: argparse's 2 are universal; flagging them would drown the rule in noise)
-EXIT_CODE_LITERALS = {70, 75, 76, 77, 78}
+EXIT_CODE_LITERALS = {70, 75, 76, 77, 78, 79}
 EXIT_CODES_SOURCE = "theanompi_tpu/resilience/codes.py"
 
 _EXIT_CALL_NAMES = {"exit", "SystemExit", "_exit"}
@@ -602,7 +602,7 @@ class ExitCodeRule(Rule):
 
     name = "exit-code"
     severity = SEV_ERROR
-    description = ("bare 70/75/76/77/78 exit-code literal — import from "
+    description = ("bare 70/75/76/77/78/79 exit-code literal — import from "
                    "theanompi_tpu.resilience.codes")
 
     def _literals_in(self, node: ast.AST) -> Iterator[ast.Constant]:

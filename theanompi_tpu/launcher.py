@@ -163,6 +163,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "resume even though the mesh / exchange strategy / "
                    "model config differ from the checkpoint's (ISSUE 5; "
                    "normally a hard refusal)")
+    p.add_argument("--resume-reshard", action="store_true",
+                   help="elastic resume (ISSUE 8; implies --resume): a "
+                   "checkpoint written under a different data-parallel "
+                   "topology is re-laid-out onto the live mesh — params "
+                   "re-replicated, zero1 optimizer shards re-padded and "
+                   "re-scattered, LR rescaled by the linear-scaling rule "
+                   "(stderr-warned).  Model-identity mismatches still "
+                   "refuse; unplannable transitions (tp/pp meshes) exit 79")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quiet", action="store_true")
     sup = p.add_argument_group(
@@ -182,6 +190,13 @@ def build_parser() -> argparse.ArgumentParser:
                      help="supervisor-side heartbeat-staleness kill switch "
                      "in seconds (backstop for a child too wedged to run "
                      "its own watchdog; off by default)")
+    sup.add_argument("--elastic", action="store_true",
+                     help="elastic supervision (ISSUE 8; implies "
+                     "--supervise): re-probe the available device count "
+                     "before every restart, rewrite the child's --devices "
+                     "to it, and resume with --resume-reshard — the pod "
+                     "comes back with fewer chips and keeps training "
+                     "(THEANOMPI_ELASTIC_DEVICES overrides the probe)")
     p.add_argument("--sentinel", default=None,
                    choices=["abort", "skip_batch", "rollback"],
                    help="non-finite loss/grad guard policy (shorthand for "
@@ -192,7 +207,8 @@ def build_parser() -> argparse.ArgumentParser:
 #: supervision-layer flags stripped from the child's command line
 #: (value = how many operands follow the flag)
 _SUPERVISOR_FLAGS = {"--supervise": 0, "--max-restarts": 1,
-                     "--backoff-base": 1, "--hang-timeout": 1}
+                     "--backoff-base": 1, "--hang-timeout": 1,
+                     "--elastic": 0}
 
 
 def _strip_supervision_args(argv: list[str]) -> list[str]:
@@ -255,6 +271,11 @@ def _supervise(argv: list[str], args) -> int:
         resilience_path=os.path.join(base, "resilience.json"),
         telemetry_dir=args.telemetry_dir,
         seed=args.seed,
+        # ISSUE 8: elastic restarts re-probe the device inventory and
+        # resume with the reshard gate open
+        elastic=args.elastic,
+        resume_args=(("--resume", "--resume-reshard") if args.elastic
+                     else ("--resume",)),
     )
     return sup.run()
 
@@ -325,6 +346,11 @@ def _build_configs(args) -> tuple[dict, dict]:
         rule_config.setdefault("sentinel_policy", args.sentinel)
     if args.resume:
         rule_config["resume"] = True
+    if args.resume_reshard:
+        # ISSUE 8: the elastic flag IS a resume (nothing to reshard onto
+        # a fresh run), with the fingerprint gate opened for replanning
+        rule_config["resume"] = True
+        rule_config["resume_reshard"] = True
     if args.resume_force:
         rule_config["resume_force"] = True
     if args.quiet:
@@ -333,14 +359,17 @@ def _build_configs(args) -> tuple[dict, dict]:
 
 
 def main(argv: list[str] | None = None) -> int:
-    """Exit-code contract (ISSUE 4/5; see the README table): 0 clean,
+    """Exit-code contract (ISSUE 4/5/8; see the README table): 0 clean,
     70 training crash, 75 resumable preemption exit, 76 watchdog hang,
-    77 checkpoint recovery chain exhausted, 78 config error — each
-    reported as ONE ``tmlauncher: ...`` stderr line
+    77 checkpoint recovery chain exhausted, 78 config error, 79 elastic
+    reshard refused (unplannable topology transition) — each reported as
+    ONE ``tmlauncher: ...`` stderr line
     (set THEANOMPI_DEBUG=1 for the full traceback), so the supervisor —
     and any outer scheduler — can classify without parsing tracebacks."""
     argv = list(sys.argv[1:]) if argv is None else list(argv)
     args = build_parser().parse_args(argv)
+    if args.elastic:
+        args.supervise = True  # elastic IS supervision with re-probing
     if args.supervise:
         return _supervise(argv, args)
 
@@ -349,11 +378,13 @@ def main(argv: list[str] | None = None) -> int:
         EXIT_CONFIG,
         EXIT_CRASH,
         EXIT_PREEMPTED,
+        EXIT_RESHARD,
         PreemptionExit,
     )
     from theanompi_tpu.utils.checkpoint import (
         CheckpointCorruptError,
         CheckpointFingerprintError,
+        CheckpointReshardError,
     )
 
     # -- config phase: wrong flags/files will not fix themselves ------------
@@ -391,9 +422,16 @@ def main(argv: list[str] | None = None) -> int:
             modelclass=args.modelclass,
             model_config=model_config,
         )
+    except CheckpointReshardError as e:
+        # ISSUE 8: --resume-reshard was set but the transition cannot be
+        # planned (tp/pp mesh, layout-family change, bucket mismatch) —
+        # a DISTINCT code: the elastic supervisor must stop, not loop
+        _error_line("reshard", e)
+        return EXIT_RESHARD
     except CheckpointFingerprintError as e:
         # a topology change, not corruption: restarting won't fix it, and
-        # the user holds the override (--resume-force) — config class
+        # the user holds the override (--resume-force, or --resume-reshard
+        # when the mismatch is reshardable) — config class
         _error_line("resume", e)
         return EXIT_CONFIG
     except CheckpointCorruptError as e:
@@ -420,6 +458,9 @@ def main(argv: list[str] | None = None) -> int:
         return EXIT_PREEMPTED
     except KeyboardInterrupt:
         raise  # a human's ^C is not a crash to classify
+    except CheckpointReshardError as e:
+        _error_line("reshard", e)
+        return EXIT_RESHARD
     except CheckpointCorruptError as e:
         # a sentinel rollback can exhaust the chain mid-training too
         _error_line("checkpoint", e)

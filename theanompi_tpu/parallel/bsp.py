@@ -56,6 +56,10 @@ class BSPTrainer(BaseTrainer):
             strategy=exch_strategy, axis_name=model.grad_reduce_axes(),
             bucket_bytes=int(float(exch_bucket_mb) * 2**20),
         )
+        if self.checkpointer is not None:
+            # ISSUE 8: the elastic reshard planner must recompute the
+            # zero1 bucket layout with the exchanger's exact bucket size
+            self.checkpointer.bucket_bytes = self.exchanger.bucket_bytes
         self.batch_spec = model.batch_partition()
 
     def _spec_trees(self):

@@ -333,6 +333,7 @@ class BaseTrainer:
                  checkpoint_async: bool = True,
                  checkpoint_verify: str = "auto",
                  resume_force: bool = False,
+                 resume_reshard: bool = False,
                  profile_dir: str | None = None,
                  profile_window: tuple[int, int] = (10, 20),
                  telemetry=None,
@@ -368,14 +369,21 @@ class BaseTrainer:
             # snapshot; serialization/publish/prune run on the writer.
             # The fingerprint is the bound method, resolved lazily —
             # subclasses set self.exchanger after this constructor runs
+            # (rules with a bucketed exchanger also backfill bucket_bytes
+            # so the ISSUE 8 reshard planner recomputes the same layout)
             self.checkpointer = Checkpointer(
                 checkpoint_dir, keep=checkpoint_keep,
                 async_save=checkpoint_async, telemetry=telemetry,
                 fault_plan=self.fault_plan,
                 fingerprint=self._run_fingerprint,
-                resume_force=resume_force)
+                resume_force=resume_force,
+                reshard=resume_reshard)
         self.optimizer = model.build_optimizer()
         self.global_batch = model.batch_size * self.n_workers
+        # ISSUE 8: an elastic resume onto a different device count scales
+        # the LR by new_n/old_n (linear-scaling rule — LR tracks the
+        # global batch at fixed per-worker batch); 1.0 = no reshard
+        self.lr_scale = 1.0
         self._step_fn = None
         self._eval_fn = None
         self.params = None
@@ -560,7 +568,8 @@ class BaseTrainer:
             return None
         return self.checkpointer.save(
             epoch, self.iteration, self.checkpoint_trees(),
-            recorder_snapshot=self.recorder.history_snapshot())
+            recorder_snapshot=self.recorder.history_snapshot(),
+            lr_scale=self.lr_scale)
 
     def _resume_verify_level(self) -> str:
         """ISSUE 5 verify policy: the cheap structural check always; the
@@ -598,6 +607,25 @@ class BaseTrainer:
             setattr(self, name, tree)  # params/state/opt_state + rule extras
         self.epoch = epoch + 1  # that epoch completed
         self.iteration = iteration
+        plan = self.checkpointer.last_reshard_plan
+        if plan is not None:
+            # ISSUE 8: the load replanned a topology change — apply the
+            # (cumulative) linear-scaling LR factor for the rest of the
+            # run and say so loudly (a silently rescaled LR would read as
+            # a lineage bug)
+            self.lr_scale = plan.lr_scale
+            print(f"trainer: RESHARD resumed a {plan.old_n}-worker "
+                  f"checkpoint onto {self.n_workers} workers: global batch "
+                  f"{self.model.batch_size * plan.old_n} -> "
+                  f"{self.global_batch} (per-worker batch fixed), LR "
+                  f"scaled x{plan.lr_scale:g} (linear-scaling rule)",
+                  file=sys.stderr, flush=True)
+        else:
+            # a plain resume of a previously-resharded lineage keeps its
+            # cumulative LR factor (stamped in the manifest)
+            man = self.checkpointer.last_loaded_manifest
+            if man is not None:
+                self.lr_scale = float(man.get("lr_scale", 1.0) or 1.0)
         self.recorder.load(self.checkpointer.directory)
         if self.recorder.verbose:
             print(f"resumed from epoch {epoch} "
@@ -951,7 +979,8 @@ class BaseTrainer:
             return False  # mid-first-epoch: resume simply starts fresh
         handle = self.checkpointer.save(
             label, self._epoch_start_iter, self.checkpoint_trees(),
-            recorder_snapshot=self.recorder.history_snapshot())
+            recorder_snapshot=self.recorder.history_snapshot(),
+            lr_scale=self.lr_scale)
         handle.join()  # synchronous: the process is about to exit
         self.checkpointer.join_pending()
         return True
@@ -1006,7 +1035,9 @@ class BaseTrainer:
                 self._epoch_start_iter = self.iteration
                 self._check_preempt()
                 self.recorder.start_epoch()
-                lr = model.adjust_hyperp(epoch)
+                # lr_scale is 1.0 except after an elastic reshard (x1.0 is
+                # float-exact, so unresharded lineages are bit-unchanged)
+                lr = model.adjust_hyperp(epoch) * self.lr_scale
                 if batches is None:  # not pre-built at the last boundary
                     batches = self._make_prefetcher(epoch)
                 it = iter(batches)
@@ -1218,6 +1249,8 @@ class Rule:
             # and the fingerprint-mismatch override (--resume-force)
             checkpoint_verify=self.config.get("checkpoint_verify", "auto"),
             resume_force=bool(self.config.get("resume_force", False)),
+            # ISSUE 8: open the elastic reshard gate (--resume-reshard)
+            resume_reshard=bool(self.config.get("resume_reshard", False)),
             profile_dir=self.config.get("profile_dir"),
             profile_window=tuple(self.config.get("profile_window", (10, 20))),
             telemetry=self.make_telemetry(),
@@ -1284,7 +1317,7 @@ class Rule:
         self.trainer = self.make_trainer(model, mesh, recorder)
         self.trainer.compile_iter_fns()
         self.trainer.init_state()
-        if self.config.get("resume"):
+        if self.config.get("resume") or self.config.get("resume_reshard"):
             self.trainer.try_resume()
         return self
 

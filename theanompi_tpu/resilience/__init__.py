@@ -34,6 +34,7 @@ from theanompi_tpu.resilience.supervisor import (  # noqa: F401
     EXIT_CRASH,
     EXIT_HANG,
     EXIT_PREEMPTED,
+    EXIT_RESHARD,
     Supervisor,
     classify_exit,
 )

@@ -15,3 +15,7 @@ EXIT_CKPT = 77       # EX_NOPERM (repurposed): checkpoint recovery chain
 #                      exhausted — no verifiable checkpoint to resume from
 #                      (fatal: a restart would walk the same empty chain)
 EXIT_CONFIG = 78     # EX_CONFIG: bad flags/config/model import
+EXIT_RESHARD = 79    # just past sysexits: elastic resume could not replan
+#                      the checkpoint onto the live topology (tp/pp mesh,
+#                      zero1<->per-leaf layout change, bucket mismatch) —
+#                      fatal: re-resharding the same pair cannot succeed

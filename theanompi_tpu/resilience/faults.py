@@ -28,9 +28,14 @@ specs separated by ``;`` or ``,``)::
                          or delete the manifest — so the verified recovery
                          chain (fallback, quarantine, exit 77) is
                          exercisable in tier-1 CPU tests
+    reshard:fail@2       ISSUE 8: the elastic reshard planner fails on
+                         supervisor attempt 2 (CheckpointReshardError ->
+                         exit 79, which the supervisor classifies FATAL —
+                         no restart loop over an unplannable transition)
 
 ``INDEX`` is the global step for ``step``, the batch ordinal for
-``prefetch``, and the epoch for ``checkpoint``.  The optional ``ATTEMPT``
+``prefetch``, the epoch for ``checkpoint``, and the supervisor attempt
+for ``reshard``.  The optional ``ATTEMPT``
 gates a spec to one supervisor attempt (``THEANOMPI_ATTEMPT``, which the
 supervisor sets; unsupervised processes count as attempt 1) — a ``kill``
 spec under supervision should carry ``@1`` so the restarted attempt does
@@ -61,6 +66,7 @@ SITES = {
     "step": ("raise", "kill", "nan"),
     "prefetch": ("stall", "raise"),
     "checkpoint": ("fail", "truncate", "bitflip", "manifest_drop"),
+    "reshard": ("fail",),
 }
 
 

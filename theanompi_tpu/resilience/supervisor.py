@@ -15,7 +15,9 @@ Exit-code contract (see the package ``__init__`` / README table)::
     0              clean        -> done
     75 / -SIGTERM  preemption   -> restart; does NOT count against budget
     76             hang         -> restart (counts)
+    77             checkpoint   -> fatal (the recovery chain is exhausted)
     2 / 78         config       -> fatal, no restart (it won't fix itself)
+    79             reshard      -> fatal (the transition is unplannable)
     anything else  crash        -> restart (counts)
 
 Every attempt is recorded — cause, exit code, duration, time lost — to a
@@ -48,6 +50,7 @@ from theanompi_tpu.resilience.codes import (
     EXIT_CRASH,
     EXIT_HANG,
     EXIT_PREEMPTED,
+    EXIT_RESHARD,
 )
 from theanompi_tpu.resilience.events import read_events
 from theanompi_tpu.resilience.watchdog import heartbeat_age_s
@@ -59,7 +62,7 @@ MAX_PREEMPTIONS = 64
 
 def classify_exit(returncode: int) -> str:
     """-> 'clean' | 'preemption' | 'hang' | 'config' | 'checkpoint' |
-    'crash'."""
+    'reshard' | 'crash'."""
     if returncode == EXIT_CLEAN:
         return "clean"
     # -SIGTERM: the preemptor's signal landed before (or instead of) the
@@ -74,6 +77,11 @@ def classify_exit(returncode: int) -> str:
     # restart would walk the same (now empty) chain: fatal, like config
     if returncode == EXIT_CKPT:
         return "checkpoint"
+    # ISSUE 8: the elastic reshard was refused (tp/pp mesh, layout-family
+    # change, bucket mismatch).  Replanning the same transition cannot
+    # succeed: fatal, never a restart loop
+    if returncode == EXIT_RESHARD:
+        return "reshard"
     # 2 is argparse's usage-error exit
     if returncode in (EXIT_CONFIG, 2):
         return "config"
@@ -87,6 +95,21 @@ class Supervisor:
     ``resume_args`` (default ``("--resume",)``) are appended from the
     second attempt on, so restarts pick up the latest checkpoint while the
     first attempt honors exactly what the user asked for.
+
+    ``elastic=True`` (ISSUE 8): before every RESTART the supervisor
+    re-probes the live device count and rewrites the child's ``--devices``
+    operand to what is actually there — "the pod comes back with fewer
+    chips and keeps training".  Pair it with
+    ``resume_args=("--resume", "--resume-reshard")`` (the launcher's
+    ``--elastic`` flag does) so the child replans the checkpoint onto the
+    probed topology instead of refusing the fingerprint mismatch.  The
+    probe: ``device_probe()`` when injected (tests), else the
+    ``THEANOMPI_ELASTIC_DEVICES`` env override (operators who already know
+    the new slice size), else a fresh ``python -c "import jax; ..."``
+    subprocess — a SUBPROCESS because only an uninitialized backend sees
+    the current device inventory (and this stdlib-only module must not
+    import jax).  Per-attempt device counts and reshard outcomes land in
+    the ``resilience.json`` attempt records.
     """
 
     def __init__(self, child_cmd: list[str], *, max_restarts: int = 3,
@@ -97,7 +120,8 @@ class Supervisor:
                  telemetry_dir: str | None = None,
                  resume_args: tuple[str, ...] = ("--resume",),
                  env: dict | None = None, seed: int = 0,
-                 sleep=None):
+                 sleep=None, elastic: bool = False, device_probe=None,
+                 probe_timeout_s: float = 120.0):
         self.child_cmd = list(child_cmd)
         self.max_restarts = max_restarts
         self.backoff_base = backoff_base
@@ -117,6 +141,11 @@ class Supervisor:
         self.resume_args = tuple(resume_args)
         self.env = dict(env or {})
         self.sleep = sleep
+        self.elastic = elastic
+        self.device_probe = device_probe
+        self.probe_timeout_s = probe_timeout_s
+        self._last_devices: int | None = None
+        self._seen_reshard_applies = 0
         self._rng = random.Random(seed)  # jittered backoff, reproducible
         self.attempts: list[dict] = []
         self._proc: subprocess.Popen | None = None
@@ -126,11 +155,89 @@ class Supervisor:
         # preemption grace period (tests inject `sleep` to fake delays)
         self._term_event = threading.Event()
 
+    # -- elastic topology probing (ISSUE 8) ----------------------------------
+    def _valid_count(self, n: int, source: str) -> int | None:
+        """A probed count must be a positive worker count — 0/negative is
+        a failed probe (keep the previous topology), not a topology."""
+        if n < 1:
+            self._log(f"ignoring nonsensical device count {n} from "
+                      f"{source}; keeping the previous topology")
+            return None
+        return n
+
+    def _probe_devices(self, attempt: int) -> int | None:
+        """The live device count, or None when unknowable (the attempt
+        then runs with the previous topology unchanged)."""
+        if self.device_probe is not None:
+            try:
+                return self._valid_count(int(self.device_probe()),
+                                         "injected probe")
+            # lint: swallow-ok — an injected probe may fail arbitrarily;
+            # the failure is logged and the restart proceeds with the
+            # previous topology instead of dying inside the supervisor
+            except Exception as e:
+                self._log(f"injected device probe failed: {e}")
+                return None
+        override = os.environ.get("THEANOMPI_ELASTIC_DEVICES")
+        if override:
+            try:
+                return self._valid_count(int(override),
+                                         "THEANOMPI_ELASTIC_DEVICES")
+            except ValueError:
+                self._log(f"ignoring non-integer "
+                          f"THEANOMPI_ELASTIC_DEVICES={override!r}")
+        try:
+            env = self._attempt_env(attempt)
+            out = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(len(jax.devices()), "
+                 "jax.default_backend())"],
+                env=env, capture_output=True,
+                text=True, timeout=self.probe_timeout_s)
+            if out.returncode != 0:
+                self._log(f"device probe exited {out.returncode}: "
+                          f"{out.stderr.strip()[-200:]}")
+                return None
+            count_s, backend = out.stdout.strip().splitlines()[-1].split()
+            if backend == "cpu" and "cpu" not in env.get(
+                    "JAX_PLATFORMS", "").lower():
+                # jax silently falls back to the CPU backend when an
+                # accelerator plugin fails to init: on a TPU VM that is a
+                # FAILED probe ("1 cpu device"), not a 1-chip topology —
+                # resharding onto it would keep "training" on host CPU
+                self._log(f"device probe fell back to the cpu backend "
+                          f"({count_s} device(s)) but JAX_PLATFORMS does "
+                          f"not pin cpu; treating as a failed probe")
+                return None
+            return self._valid_count(int(count_s), "jax probe")
+        except (OSError, subprocess.SubprocessError, ValueError,
+                IndexError) as e:
+            self._log(f"device probe failed: {e}")
+            return None
+
+    @staticmethod
+    def _with_devices(cmd: list[str], n: int) -> list[str]:
+        """Rewrite the ``--devices`` operand (including ``--devices all``
+        — "all" is exactly what changed) to the probed count.  A command
+        without the flag is left alone: the child discovers all devices
+        itself, which is already elastic."""
+        out = list(cmd)
+        for i, a in enumerate(out):
+            if a == "--devices" and i + 1 < len(out):
+                out[i + 1] = str(n)
+                return out
+            if a.startswith("--devices="):
+                out[i] = f"--devices={n}"
+                return out
+        return out
+
     # -- one attempt ---------------------------------------------------------
     def _attempt_cmd(self, attempt: int) -> list[str]:
         cmd = list(self.child_cmd)
         if attempt > 1:
             cmd += [a for a in self.resume_args if a not in cmd]
+            if self.elastic and self._last_devices is not None:
+                cmd = self._with_devices(cmd, self._last_devices)
         return cmd
 
     def _attempt_env(self, attempt: int) -> dict:
@@ -211,6 +318,11 @@ class Supervisor:
         t_run0 = time.perf_counter()
         attempt, restarts, preemptions = 0, 0, 0
         final = EXIT_CRASH
+        if self.elastic:
+            # baseline against events a PREVIOUS supervised run left in
+            # the (carried-forward) resilience.json — only applies newer
+            # than this run's start may stamp an attempt as resharded
+            self._seen_reshard_applies = self._count_reshard_applies()
         while True:
             if self._terminated:
                 # SIGTERM landed between attempts (during backoff): never
@@ -224,6 +336,17 @@ class Supervisor:
                     os.remove(self.heartbeat_path)  # stale mtime = insta-kill
                 except OSError:
                     pass  # lint: swallow-ok — heartbeat already absent
+            if self.elastic and attempt > 1:
+                # re-probe what is actually there before every restart —
+                # the previous death may BE a topology change (preempted
+                # chips); the child gets the probed count + the reshard
+                # flag (resume_args) and replans the checkpoint onto it
+                probed = self._probe_devices(attempt)
+                if probed is not None:
+                    if probed != self._last_devices:
+                        self._log(f"elastic: probed {probed} device(s) "
+                                  f"for attempt {attempt}")
+                    self._last_devices = probed
             cmd = self._attempt_cmd(attempt)
             self._log(f"attempt {attempt}: {' '.join(cmd)}")
             t0 = time.perf_counter()
@@ -237,6 +360,11 @@ class Supervisor:
             cause = "hang" if hung else classify_exit(rc)
             rec = {"attempt": attempt, "cause": cause, "exit_code": rc,
                    "duration_s": round(dur, 3)}
+            if self.elastic and self._last_devices is not None:
+                rec["devices"] = self._last_devices
+            outcome = self._reshard_outcome(rc)
+            if outcome is not None:
+                rec["reshard"] = outcome
             if cause not in ("clean", "preemption"):
                 # progress since the last published checkpoint is gone; the
                 # attempt's whole duration is the honest upper bound
@@ -259,6 +387,17 @@ class Supervisor:
                           f"recovery chain (exit {rc}); not restarting — "
                           f"inspect <checkpoint-dir>/corrupt/ and "
                           f"resilience.json")
+                final = rc
+                break
+            if cause == "reshard":
+                # ISSUE 8: the transition is unplannable (tp/pp mesh,
+                # layout-family change, bucket mismatch) — replanning the
+                # same pair cannot succeed, so a restart is a fatal loop
+                self._log(f"attempt {attempt} could not reshard the "
+                          f"checkpoint onto the live topology (exit {rc}); "
+                          f"not restarting — dry-run `python -m "
+                          f"theanompi_tpu.utils.checkpoint --reshard-plan "
+                          f"<checkpoint-dir> --to-devices N` to see why")
                 final = rc
                 break
             if cause == "config":
@@ -308,6 +447,24 @@ class Supervisor:
         self._emit({"name": "supervisor.done", "final_exit": final,
                     "restarts": restarts, "preemptions": preemptions})
         return final
+
+    def _count_reshard_applies(self) -> int:
+        return sum(1 for e in read_events(self.resilience_path)
+                   if e.get("name") == "reshard.apply")
+
+    def _reshard_outcome(self, rc: int) -> str | None:
+        """'applied' when the attempt recorded a fresh ``reshard.apply``
+        event in resilience.json (the child's checkpointer writes them),
+        'failed' when it died with the reshard exit code, None otherwise."""
+        if not self.elastic:
+            return None
+        applies = self._count_reshard_applies()
+        if applies > self._seen_reshard_applies:
+            self._seen_reshard_applies = applies
+            return "applied"
+        if rc == EXIT_RESHARD:
+            return "failed"
+        return None
 
     # -- reporting -----------------------------------------------------------
     def summary(self, final, t_run0, restarts, preemptions) -> dict:

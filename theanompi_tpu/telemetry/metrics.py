@@ -69,6 +69,16 @@ SERVE_HISTOGRAMS = ("serve.token_ms", "serve.ttft_ms")
 SERVE_GAUGES = ("serve.tokens_per_sec", "serve.active", "serve.free_blocks")
 SERVE_COUNTERS = ("serve.tokens", "serve.preemptions", "serve.requests")
 
+# -- elastic-resume instant names (ISSUE 8) ----------------------------------
+# The checkpoint reshard path emits through these registered names ONLY
+# (same one-source-of-truth contract as the serving names above).
+# ``reshard.plan``: a topology mismatch was replanned from the manifest
+# alone (tags: epoch, old_n, new_n, strategy, lr_scale, n_buckets);
+# ``reshard.apply``: the re-laid-out state was restored onto the live mesh
+# (tags: epoch, old_n, new_n).  Both also land as events in the shared
+# ``resilience.json`` audit log via ``resilience/events.py``.
+RESHARD_INSTANTS = ("reshard.plan", "reshard.apply")
+
 
 class MetricsRegistry:
     """Named counters (monotonic totals), gauges (last value), histograms

@@ -72,12 +72,32 @@ sentinel ``rollback``, cold ``--resume``) trusts these bytes:
 ``_prune`` counts only checkpoints that pass fast verification toward
 ``keep`` and never deletes the newest verifiable one — n corrupt newer
 files can no longer rotate a run's only good ancestor out of existence.
+
+**Elastic reshard (ISSUE 8)** — the fingerprint check becomes a *gate*
+instead of a wall: a mismatch confined to the topology keys
+(mesh/exchange/``n_subb``) raises the typed
+:class:`CheckpointReshardableMismatch`, and a Checkpointer constructed
+with ``reshard=True`` (``--resume-reshard`` / the supervisor's
+``--elastic`` mode) catches it and *replans* from the manifest alone
+(:func:`plan_reshard`): replicated params/state re-place through the new
+topology's templates, zero1 flat-bucket optimizer shards are re-padded
+for the new device count and re-scattered, and the LR linear-scaling
+factor rides out on :class:`ReshardPlan`.  Model-identity mismatches stay
+fatal, and every unplannable transition (tp/pp meshes, layout-family
+changes, bucket-padding disagreements) is a typed
+:class:`CheckpointReshardError` → ``tmlauncher`` exit ``EXIT_RESHARD=79``
+(fatal to the supervisor).  ``reshard.plan``/``reshard.apply`` events
+land in ``resilience.json`` + telemetry; the scrubber CLI dry-runs a plan
+with ``--reshard-plan DIR --to-devices N`` (manifest-only — safe against
+a live writer).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
+import re
 import sys
 import threading
 import time
@@ -111,6 +131,24 @@ class CheckpointFingerprintError(CheckpointError):
     exchange strategy / n_subb / model config).  A hard refusal, not a
     corruption: falling back to an older checkpoint would mismatch too.
     Override with ``--resume-force`` / the ``resume_force`` rule key."""
+
+
+class CheckpointReshardableMismatch(CheckpointFingerprintError):
+    """A fingerprint mismatch confined to the RESHARDABLE keys (mesh /
+    exchange / n_subb): the model identity matches, so the checkpoint can
+    be re-laid-out onto the live topology with ``--resume-reshard``
+    (ISSUE 8) instead of refused.  Still a refusal without that flag —
+    resuming blind would desynchronize exactly like any other mismatch."""
+
+
+class CheckpointReshardError(CheckpointError):
+    """An elastic resume (``--resume-reshard``) was asked to replan a
+    transition that cannot be planned — a tp/pp/sp mesh, a
+    zero1<->per-leaf optimizer-layout change, rule extras (EASGD/GOSGD
+    stacked worker state), or flat-bucket shards whose padding disagrees
+    with the recomputed layout (``exch_bucket_mb`` changed).  Fatal
+    (``tmlauncher`` exits ``EXIT_RESHARD=79``; the supervisor does not
+    restart): replanning the same pair cannot succeed."""
 
 
 def _to_host(leaf) -> np.ndarray:
@@ -169,14 +207,24 @@ def _leaf_crc(arr: np.ndarray) -> int:
 
 def build_manifest(epoch: int, iteration: int,
                    flat: dict[str, np.ndarray],
-                   fingerprint: dict | None) -> dict:
+                   fingerprint: dict | None,
+                   lr_scale: float = 1.0) -> dict:
     """Deterministic manifest for a flat leaf dict: no timestamps, sorted
     keys at serialization time — async and sync saves of the same state
-    must produce byte-identical manifests (tested)."""
+    must produce byte-identical manifests (tested).
+
+    ``lr_scale`` (ISSUE 8): the CUMULATIVE linear-scaling LR factor of
+    this lineage relative to its original topology (1.0 until an elastic
+    reshard changes the device count).  Persisted so a later resume — or
+    a reshard back to the original count — composes factors instead of
+    re-deriving from the wrong baseline: mesh8 -> mesh4 -> mesh8 nets
+    exactly 1.0 again.
+    """
     return {
         "format": MANIFEST_VERSION,
         "epoch": int(epoch),
         "iteration": int(iteration),
+        "lr_scale": float(lr_scale),
         "fingerprint": fingerprint,
         "leaves": {
             k: {
@@ -286,6 +334,13 @@ def _normalize_fp(fp: dict) -> dict:
     return json.loads(json.dumps(fp, sort_keys=True))
 
 
+#: fingerprint keys a topology change may legitimately move (ISSUE 8):
+#: mesh shape, exchange strategy, accumulation depth.  The model-identity
+#: keys (``model``/``model_config_sha``) are NEVER reshardable — a
+#: different model is a different run, not a different slice size.
+RESHARDABLE_FP_KEYS = ("mesh", "exchange", "n_subb")
+
+
 def check_fingerprint(manifest: dict, mine: dict | None,
                       npz_path: str, force: bool = False,
                       subset: bool = False) -> None:
@@ -293,6 +348,13 @@ def check_fingerprint(manifest: dict, mine: dict | None,
 
     Skipped when either side carries no fingerprint (bare library use,
     pre-integrity manifests) — absence is not a mismatch.
+
+    The refusal names the exact differing keys and is TYPED by what
+    differs (ISSUE 8): a mismatch confined to the reshardable topology
+    keys (mesh / exchange / n_subb) raises
+    :class:`CheckpointReshardableMismatch` — the elastic resume path can
+    catch it and replan — while any model-identity difference raises the
+    plain (fatal) :class:`CheckpointFingerprintError`.
 
     ``subset=True`` compares only the keys ``mine`` provides — the serving
     consumer's mode (ISSUE 6): an inference process has no mesh or exchange
@@ -309,26 +371,294 @@ def check_fingerprint(manifest: dict, mine: dict | None,
         theirs = {k: v for k, v in theirs.items() if k in mine}
     if mine == theirs:
         return
+    diff_keys = sorted(k for k in set(theirs) | set(mine)
+                       if theirs.get(k) != mine.get(k))
     diffs = ", ".join(
         f"{k}: checkpoint={theirs.get(k)!r} != run={mine.get(k)!r}"
-        for k in sorted(set(theirs) | set(mine))
-        if theirs.get(k) != mine.get(k))
+        for k in diff_keys)
+    reshardable = (not subset
+                   and all(k in RESHARDABLE_FP_KEYS for k in diff_keys))
     if subset:
         what = ("this checkpoint was trained with a different model "
                 f"class/config ({diffs}). Serving it would silently mismap "
                 f"weights; reproduce the training --set flags, or pass "
                 f"--serve-force to override")
+    elif reshardable:
+        what = (f"the topology keys [{', '.join(diff_keys)}] differ "
+                f"({diffs}) but the model identity matches. Resuming blind "
+                f"would desynchronize; this mismatch is RESHARDABLE — pass "
+                f"--resume-reshard (rule key resume_reshard=True, or run "
+                f"under --elastic supervision) to re-layout onto the live "
+                f"topology, or --resume-force to override blind")
     else:
-        what = ("this checkpoint was written under a different topology "
-                f"({diffs}). Resuming would desynchronize or silently "
-                f"retrain; pass --resume-force (rule key resume_force=True) "
-                f"to override")
+        fatal = [k for k in diff_keys if k not in RESHARDABLE_FP_KEYS]
+        what = (f"the model-identity keys [{', '.join(fatal)}] differ "
+                f"({diffs}): this checkpoint belongs to a different "
+                f"model/run and is NOT reshardable; pass --resume-force "
+                f"(rule key resume_force=True) to override")
     msg = f"{os.path.basename(npz_path)}: run fingerprint mismatch — {what}."
     if force:
         print(f"checkpoint: WARNING: {msg} — proceeding (force)",
               file=sys.stderr, flush=True)
         return
+    if reshardable:
+        raise CheckpointReshardableMismatch(msg)
     raise CheckpointFingerprintError(msg)
+
+
+# -- elastic reshard planning (ISSUE 8) --------------------------------------
+#
+# A reshard is PLANNED from the manifest alone (per-leaf shapes/dtypes plus
+# the run fingerprint) before a single checkpoint byte is read: replicated
+# params/state restore onto the new mesh through the ordinary template
+# placement, and zero1's flat-bucket optimizer shards — whose padding is a
+# function of the device count — are re-laid-out by stripping the old
+# padding and re-padding for the new count (bucket BOUNDARIES are
+# n-independent: the greedy layout walk only pads the tail).  Everything
+# the planner cannot prove safe is a typed refusal, never a best guess.
+
+def _natural_path_key(path: str):
+    """Sort key reproducing jax's tree-flatten order from a joined leaf
+    path: dict keys flatten string-sorted, list/tuple entries positional.
+    The manifest file is ``sort_keys``-serialized, which string-sorts the
+    numeric list indices (``blocks/10`` before ``blocks/2``); comparing
+    purely-numeric path components as ints restores positional order.
+    (Assumes no dict keys that are themselves all-digits — none exist in
+    this repo's pytrees.)"""
+    return tuple((0, int(part), "") if part.isdigit() else (1, 0, part)
+                 for part in path.split("/"))
+
+
+def _manifest_leaves(manifest: dict, tree: str) -> list[tuple[str, dict]]:
+    """(leaf path, meta) entries of one named tree, re-sorted into the
+    flatten order ``_snapshot`` wrote them in (see ``_natural_path_key``)."""
+    prefix = f"{tree}::"
+    entries = [(k[len(prefix):], meta)
+               for k, meta in manifest["leaves"].items()
+               if k.startswith(prefix)]
+    entries.sort(key=lambda kv: _natural_path_key(kv[0]))
+    return entries
+
+
+_OPT_BUCKET_RE = re.compile(r"^opt_state::(.+)/(\d+)$")
+
+
+@dataclasses.dataclass
+class ReshardPlan:
+    """One planned topology transition (fingerprint A -> live topology B).
+
+    Produced by :func:`plan_reshard` from a manifest alone; applied by
+    :meth:`ReshardPlan.transform_arrays` to the loaded flat leaf dict just
+    before template restore (the template's shardings then scatter the
+    re-laid-out buffers onto the new mesh)."""
+
+    old_n: int
+    new_n: int
+    strategy_old: str
+    strategy_new: str
+    #: linear-scaling rule: LR tracks the global batch, which tracks the
+    #: worker count at fixed per-worker batch
+    lr_scale: float
+    #: per-bucket ``(payload elems, old padded, new padded)`` for zero1
+    #: flat-bucket optimizer shards; None when no flat-bucket state rides
+    buckets: list[tuple[int, int, int]] | None
+    warnings: list[str]
+
+    def summary(self) -> dict:
+        out = {"old_n": self.old_n, "new_n": self.new_n,
+               "strategy": self.strategy_new,
+               "lr_scale": round(self.lr_scale, 6)}
+        if self.buckets is not None:
+            out["n_buckets"] = len(self.buckets)
+        return out
+
+    def describe(self) -> str:
+        """The dry-run report (scrubber CLI ``--reshard-plan`` + the
+        stderr warning block at an actual elastic resume)."""
+        lines = [f"reshard plan: {self.old_n} -> {self.new_n} workers "
+                 f"(exchange {self.strategy_old} -> {self.strategy_new}, "
+                 f"LR x{self.lr_scale:g})"]
+        if self.buckets is not None:
+            lines.append(
+                f"  zero1 flat buckets ({len(self.buckets)}): re-scatter "
+                f"P(data) optimizer shards across {self.new_n} devices")
+            for i, (elems, old_p, new_p) in enumerate(self.buckets):
+                lines.append(
+                    f"    bucket {i}: payload {elems} elems, padding "
+                    f"{old_p - elems} -> {new_p - elems} "
+                    f"(buffer {old_p} -> {new_p})")
+        for w in self.warnings:
+            lines.append(f"  note: {w}")
+        return "\n".join(lines)
+
+    def transform_arrays(self, arrays: dict) -> dict:
+        """Re-layout the loaded flat leaf dict for the new topology:
+        zero1 flat-bucket optimizer shards lose the old tail padding and
+        gain the new (padding is zeros by construction — ``_pack`` pads
+        gradient and param buckets with zeros, and every update rule is
+        elementwise, so the padded tail provably stays zero)."""
+        if self.buckets is None:
+            return arrays
+        out = dict(arrays)
+        for key, arr in arrays.items():
+            m = _OPT_BUCKET_RE.match(key)
+            if m is None or getattr(arr, "ndim", None) != 1:
+                continue
+            i = int(m.group(2))
+            if i >= len(self.buckets):
+                raise CheckpointReshardError(
+                    f"{key}: bucket index {i} outside the planned layout "
+                    f"({len(self.buckets)} buckets)")
+            elems, old_padded, new_padded = self.buckets[i]
+            if arr.shape[0] != old_padded:
+                raise CheckpointReshardError(
+                    f"{key}: {arr.shape[0]} elements, the plan expected "
+                    f"{old_padded}")
+            if old_padded == new_padded:
+                continue
+            payload = np.asarray(arr)[:elems]
+            if new_padded > elems:
+                payload = np.concatenate(
+                    [payload, np.zeros((new_padded - elems,), arr.dtype)])
+            out[key] = np.ascontiguousarray(payload)
+        return out
+
+
+def _plan_zero1_buckets(manifest: dict, old_n: int, new_n: int,
+                        bucket_bytes: int | None) -> list[tuple[int, int, int]]:
+    """Recompute the flat-bucket layout at both device counts from the
+    manifest's param leaf shapes, and validate every stored opt_state
+    bucket shard against the old layout — a silent disagreement (an
+    ``exch_bucket_mb`` change between runs) would truncate real optimizer
+    state, so it must refuse instead."""
+    # host-side twin of Exchanger.zero1_layout — a deliberate lazy edge
+    # (ckpt layer -> exchange layer), same idiom as _restore_into's
+    from theanompi_tpu.parallel.exchanger import (
+        DEFAULT_BUCKET_BYTES,
+        _bucket_layout,
+    )
+
+    if bucket_bytes is None:
+        bucket_bytes = DEFAULT_BUCKET_BYTES
+    p_structs = [
+        jax.ShapeDtypeStruct(tuple(meta["shape"]), np.dtype(meta["dtype"]))
+        for _, meta in _manifest_leaves(manifest, "params")
+    ]
+    old_layout = _bucket_layout(p_structs, bucket_bytes, max(1, old_n))
+    new_layout = _bucket_layout(p_structs, bucket_bytes, max(1, new_n))
+    fields: dict[str, dict[int, int]] = {}
+    for path, meta in _manifest_leaves(manifest, "opt_state"):
+        field, _, idx = path.rpartition("/")
+        if field and idx.isdigit() and len(meta["shape"]) == 1:
+            fields.setdefault(field, {})[int(idx)] = int(meta["shape"][0])
+    if not fields:
+        raise CheckpointReshardError(
+            "exchange is zero1 but the manifest's opt_state holds no flat "
+            "bucket shards — cannot validate the re-layout")
+    for field, lens in fields.items():
+        if sorted(lens) != list(range(len(old_layout))):
+            raise CheckpointReshardError(
+                f"opt_state field {field!r} holds bucket indices "
+                f"{sorted(lens)} but the recomputed layout has "
+                f"{len(old_layout)} buckets — was exch_bucket_mb changed "
+                f"since the checkpoint was written?")
+        for i, ln in lens.items():
+            if ln != old_layout[i].padded:
+                raise CheckpointReshardError(
+                    f"opt_state {field!r} bucket {i} stores {ln} elements "
+                    f"but the recomputed layout says {old_layout[i].padded} "
+                    f"(payload {old_layout[i].elems} padded to n={old_n}) — "
+                    f"non-divisible bucket padding; was exch_bucket_mb "
+                    f"changed since the checkpoint was written?")
+    return [(ob.elems, ob.padded, nb.padded)
+            for ob, nb in zip(old_layout, new_layout)]
+
+
+def plan_reshard(manifest: dict, target_fp: dict,
+                 bucket_bytes: int | None = None) -> ReshardPlan:
+    """Plan restoring a fingerprint-A checkpoint onto topology B — from
+    the manifest ALONE (no checkpoint bytes read), so the scrubber CLI can
+    dry-run it against a directory a live writer owns.
+
+    Raises :class:`CheckpointReshardError` on every unplannable
+    transition: missing fingerprint, model-identity mismatch, tp/sp/pp
+    meshes on either side, rule extras (stacked per-worker state), a
+    zero1<->per-leaf optimizer-layout change, or stored bucket shards that
+    disagree with the recomputed layout.
+    """
+    theirs = manifest.get("fingerprint")
+    if theirs is None:
+        raise CheckpointReshardError(
+            "manifest carries no run fingerprint (pre-integrity "
+            "checkpoint) — nothing to plan a reshard from")
+    old = _normalize_fp(theirs)
+    new = _normalize_fp(target_fp)
+    fatal = sorted(k for k in set(old) | set(new)
+                   if old.get(k) != new.get(k)
+                   and k not in RESHARDABLE_FP_KEYS)
+    if fatal:
+        diffs = ", ".join(f"{k}: checkpoint={old.get(k)!r} != "
+                          f"run={new.get(k)!r}" for k in fatal)
+        raise CheckpointReshardError(
+            f"model-identity keys {fatal} differ ({diffs}) — that is a "
+            f"different model, not a topology change; reshard refused")
+    for side, mesh in (("checkpoint", dict(old.get("mesh") or {})),
+                       ("run", dict(new.get("mesh") or {}))):
+        sharded = {a: int(s) for a, s in mesh.items()
+                   if a != "data" and int(s) > 1}
+        if sharded:
+            raise CheckpointReshardError(
+                f"{side} mesh shards non-data axes {sharded}: tensor/"
+                f"sequence/pipeline-parallel state cannot be re-laid-out "
+                f"from the manifest alone; reshard refused")
+    old_n = int((old.get("mesh") or {}).get("data", 1))
+    new_n = int((new.get("mesh") or {}).get("data", 1))
+    if old_n < 1 or new_n < 1:
+        raise CheckpointReshardError(
+            f"nonsensical data-axis sizes (checkpoint {old_n}, run {new_n})")
+    tree_names = {k.split("::", 1)[0] for k in manifest.get("leaves", {})}
+    extras = sorted(tree_names - {"params", "state", "opt_state"})
+    if extras:
+        raise CheckpointReshardError(
+            f"checkpoint carries rule extras {extras} (stacked per-worker "
+            f"state, EASGD/GOSGD-style): only the data-parallel BSP layout "
+            f"reshards; reshard refused")
+    s_old = str(old.get("exchange"))
+    s_new = str(new.get("exchange"))
+    if (s_old == "zero1") != (s_new == "zero1"):
+        raise CheckpointReshardError(
+            f"optimizer-state layout changes between zero1 flat buckets "
+            f"and per-leaf trees ({s_old!r} -> {s_new!r}): repacking is "
+            f"not planned; resume within the same strategy family")
+    warnings: list[str] = []
+    buckets = None
+    if s_old == "zero1":
+        buckets = _plan_zero1_buckets(manifest, old_n, new_n, bucket_bytes)
+        if old_n != new_n:
+            warnings.append(
+                f"zero1 optimizer shards re-laid-out: {len(buckets)} "
+                f"bucket(s) re-padded for n={new_n} and re-scattered "
+                f"P(data) across the new mesh")
+    # compose with the lineage's CARRIED factor (a checkpoint that was
+    # already resharded once stamps its cumulative scale): mesh8 -> mesh4
+    # -> mesh8 nets exactly 1.0 against the originally tuned LR
+    carried = float(manifest.get("lr_scale", 1.0) or 1.0)
+    lr_scale = carried * new_n / old_n
+    if new_n != old_n:
+        warnings.append(
+            f"global batch scales with the device count ({old_n} -> "
+            f"{new_n} workers at fixed per-worker batch); LR rescaled "
+            f"x{lr_scale:g} total (linear-scaling rule"
+            + (f"; carries x{carried:g} from an earlier reshard)"
+               if carried != 1.0 else ")"))
+    if old.get("n_subb") != new.get("n_subb"):
+        warnings.append(
+            f"n_subb changes {old.get('n_subb')} -> {new.get('n_subb')} "
+            f"(accumulation depth carries no state; micro-batch statistics "
+            f"shift within the documented sub-batching semantics)")
+    return ReshardPlan(old_n=old_n, new_n=new_n, strategy_old=s_old,
+                       strategy_new=s_new, lr_scale=lr_scale,
+                       buckets=buckets, warnings=warnings)
 
 
 class SaveHandle:
@@ -380,11 +710,24 @@ class Checkpointer:
                  async_save: bool = False, telemetry=None,
                  fault_plan=None, fingerprint=None,
                  resume_force: bool = False, sweep_debris: bool = True,
-                 read_only: bool = False, fingerprint_subset: bool = False):
+                 read_only: bool = False, fingerprint_subset: bool = False,
+                 reshard: bool = False, bucket_bytes: int | None = None):
         self.directory = directory
         self.keep = keep
         self.async_save = async_save
         self.telemetry = telemetry
+        # ISSUE 8: elastic resume — a RESHARDABLE fingerprint mismatch
+        # (mesh/exchange/n_subb only) is replanned from the manifest
+        # instead of refused; ``bucket_bytes`` must match the exchanger's
+        # so the zero1 layout recomputation agrees (the trainer wires it)
+        self.reshard = reshard
+        self.bucket_bytes = bucket_bytes
+        #: the plan applied by the most recent resharded load (the trainer
+        #: reads lr_scale and the warning context from here)
+        self.last_reshard_plan: ReshardPlan | None = None
+        #: manifest of the most recent load_latest_verified restore —
+        #: carries the lineage's cumulative lr_scale for plain resumes
+        self.last_loaded_manifest: dict | None = None
         # ISSUE 6: a read-only consumer (load_for_inference) never mutates
         # the directory — no debris sweep, no dirty marker, no quarantine,
         # no resilience events, and save() refuses outright.  Safe to point
@@ -530,8 +873,11 @@ class Checkpointer:
         return out
 
     def save(self, epoch: int, iteration: int, trees: dict,
-             recorder_snapshot: dict | None = None) -> SaveHandle:
+             recorder_snapshot: dict | None = None,
+             lr_scale: float = 1.0) -> SaveHandle:
         """``trees``: name -> pytree (params/state/opt_state/extras).
+        ``lr_scale``: the lineage's cumulative linear-scaling LR factor
+        (see :func:`build_manifest`; the trainer threads its own through).
 
         On a multi-host pod every process must call this (the host-gather of
         cross-host-sharded leaves is a collective); only process 0 writes.
@@ -553,13 +899,14 @@ class Checkpointer:
             return handle
         self._mark_dirty()
         if not self.async_save:
-            self._write(handle, epoch, iteration, flat, recorder_snapshot)
+            self._write(handle, epoch, iteration, flat, recorder_snapshot,
+                        lr_scale)
             return handle
 
         def work():
             try:
                 self._write(handle, epoch, iteration, flat,
-                            recorder_snapshot)
+                            recorder_snapshot, lr_scale)
             except BaseException as e:
                 handle._error = e
 
@@ -571,7 +918,8 @@ class Checkpointer:
 
     def _write(self, handle: SaveHandle, epoch: int, iteration: int,
                flat: dict[str, np.ndarray],
-               recorder_snapshot: dict | None) -> None:
+               recorder_snapshot: dict | None,
+               lr_scale: float = 1.0) -> None:
         """Serialize + atomically publish + prune + scrub (writer thread in
         async mode, inline in sync mode — one code path, so the published
         bytes, manifest included, are identical either way)."""
@@ -584,7 +932,8 @@ class Checkpointer:
         tmp = handle.path + ".tmp.npz"
         np.savez(tmp, **flat)
         manifest = build_manifest(epoch, iteration, flat,
-                                  self._resolved_fingerprint())
+                                  self._resolved_fingerprint(),
+                                  lr_scale=lr_scale)
         mpath = _manifest_path(handle.path)
         with open(mpath + ".tmp", "w") as f:
             json.dump(manifest, f, sort_keys=True, indent=1)
@@ -844,14 +1193,71 @@ class Checkpointer:
     def latest_iteration(self) -> int:
         return self._synced_latest()[1]
 
+    # -- elastic reshard (ISSUE 8) -------------------------------------------
+    def _plan_reshard(self, manifest: dict, epoch: int) -> ReshardPlan:
+        """Plan + audit one topology transition for ``epoch``; raises
+        :class:`CheckpointReshardError` when unplannable (including the
+        deterministic ``reshard:fail@ATTEMPT`` fault site, which fires
+        AFTER planning so the failure lands exactly where a real one
+        would — between plan and apply)."""
+        plan = plan_reshard(manifest, self._resolved_fingerprint(),
+                            bucket_bytes=self.bucket_bytes)
+        if self.fault_plan is not None:
+            from theanompi_tpu.resilience.faults import current_attempt
+
+            if self.fault_plan.fire("reshard", current_attempt()) == "fail":
+                raise CheckpointReshardError(
+                    f"injected reshard failure "
+                    f"(attempt {current_attempt()})")
+        print(f"checkpoint: RESHARD epoch {epoch}: {plan.describe()}",
+              file=sys.stderr, flush=True)
+        # names registered in telemetry/metrics.py (RESHARD_INSTANTS)
+        self._record_event("reshard.plan", epoch=epoch, **plan.summary())
+        if self.telemetry is not None:
+            self.telemetry.instant("reshard.plan", epoch=epoch,
+                                   **plan.summary())
+        return plan
+
+    def _record_reshard_apply(self, plan: ReshardPlan, epoch: int) -> None:
+        self.last_reshard_plan = plan
+        self._record_event("reshard.apply", epoch=epoch,
+                           old_n=plan.old_n, new_n=plan.new_n)
+        if self.telemetry is not None:
+            self.telemetry.instant("reshard.apply", epoch=epoch,
+                                   old_n=plan.old_n, new_n=plan.new_n)
+
     # -- verified load -------------------------------------------------------
+    def _check_manifest_fingerprint(self, manifest: dict,
+                                    epoch: int) -> None:
+        """The fingerprint half of :meth:`verify_epoch`.
+
+        With the reshard gate open, a topology-only mismatch always
+        RAISES :class:`CheckpointReshardableMismatch` — even under
+        ``resume_force`` — so the caller replans instead of force's blind
+        restore (which would place old-n zero1 shards into new-n
+        templates and crash untyped).  ``resume_force`` still downgrades
+        the remaining (model-identity) mismatches to a warning."""
+        mine = self._resolved_fingerprint()
+        path = self._path(epoch)
+        if self.reshard:
+            try:
+                check_fingerprint(manifest, mine, path, force=False,
+                                  subset=self.fingerprint_subset)
+                return
+            except CheckpointReshardableMismatch:
+                raise
+            except CheckpointFingerprintError:
+                if not self.resume_force:
+                    raise
+                # fatal mismatch + force: fall through to the warn path
+        check_fingerprint(manifest, mine, path, force=self.resume_force,
+                          subset=self.fingerprint_subset)
+
     def verify_epoch(self, epoch: int, level: str = "full") -> dict:
         """Verify one retained epoch (file integrity + fingerprint);
         -> its manifest."""
         man = verify_file(self._path(epoch), level=level)
-        check_fingerprint(man, self._resolved_fingerprint(),
-                          self._path(epoch), force=self.resume_force,
-                          subset=self.fingerprint_subset)
+        self._check_manifest_fingerprint(man, epoch)
         return man
 
     def load_latest_verified(self, templates: dict,
@@ -873,11 +1279,39 @@ class Checkpointer:
         ``latest.json``) — the escape hatch for manifest-less legacy dirs.
         """
         self.join_pending()
+        # per-restore reshard bookkeeping: a later load at matching
+        # topology (sentinel rollback) must not see a stale plan
+        self.last_reshard_plan = None
+        self.last_loaded_manifest = None
         if verify == "none":
             ep, it = self._synced_latest()
             if ep < 0:
-                return None
-            return ep, it, self.load(ep, templates, verify="none")
+                return None  # empty dir: a fresh start, reshard or not
+            if self.reshard:
+                # the gate needs the manifest verify='none' skips: a
+                # silent pass-through would either shape-crash untyped or
+                # — worse, when paddings coincide — restore without the
+                # LR rescale.  Refuse with the typed contract instead
+                raise CheckpointReshardError(
+                    "--resume-reshard requires verified loads: "
+                    "checkpoint_verify='none' skips the manifest the "
+                    "reshard plan is computed from")
+            restored = self.load(ep, templates, verify="none")
+            # best-effort lr_scale carry (ISSUE 8): a resharded lineage's
+            # cumulative factor must survive even the legacy no-verify
+            # path.  Single-host only — on a pod, a manifest visible on
+            # process 0 alone would desynchronize the LR scalar across
+            # the SPMD program (and multihost never reshards anyway)
+            mpath = _manifest_path(self._path(ep))
+            if jax.process_count() == 1 and os.path.exists(mpath):
+                try:
+                    with open(mpath) as f:
+                        self.last_loaded_manifest = json.load(f)
+                except (OSError, ValueError):  # lint: swallow-ok — a
+                    pass  # damaged/legacy manifest under verify='none',
+                    # which promised to restore regardless; there is
+                    # simply no cumulative LR factor to carry
+            return ep, it, restored
         if jax.process_count() > 1:
             return self._load_latest_verified_multihost(templates, verify)
         epochs = self.available_epochs()
@@ -891,9 +1325,19 @@ class Checkpointer:
                 # read inside load() — one decompress pass, not two.  The
                 # verified manifest is handed down so load() does not
                 # repeat the fast check (or a resume_force warning)
-                man = self.verify_epoch(ep, level="fast")
+                plan = None
+                man = verify_file(self._path(ep), level="fast")
+                try:
+                    self._check_manifest_fingerprint(man, ep)
+                except CheckpointReshardableMismatch:
+                    if not self.reshard:
+                        raise
+                    # ISSUE 8: the gate opens — replan the topology from
+                    # the manifest just verified (one read, not two)
+                    plan = self._plan_reshard(man, ep)
                 restored = self.load(ep, templates, verify=verify,
-                                     _verified_manifest=man)
+                                     _verified_manifest=man,
+                                     _reshard_plan=plan)
             except CheckpointCorruptError as e:
                 print(f"checkpoint: {e}; stepping back to the previous "
                       f"checkpoint", file=sys.stderr, flush=True)
@@ -903,6 +1347,7 @@ class Checkpointer:
             it = int(man.get("iteration", 0))
             if skipped:
                 self._record_fallback(skipped, ep, it, verify)
+            self.last_loaded_manifest = man
             return ep, it, restored
         raise CheckpointChainExhausted(
             f"no verifiable checkpoint left in {self.directory}: all "
@@ -912,7 +1357,12 @@ class Checkpointer:
     def _load_latest_verified_multihost(self, templates: dict, verify: str):
         """Chain selection on process 0, verdict broadcast to every process
         (a one-sided raise inside the later array broadcast would hang the
-        pod — same discipline as ``_load_multihost``)."""
+        pod — same discipline as ``_load_multihost``).
+
+        The ISSUE 8 reshard gate does NOT open here: a reshardable
+        mismatch surfaces as the (subclassed) fingerprint refusal on every
+        process — multi-host elastic resume would need a process-count
+        change too, which no in-process replan can deliver."""
         from jax.experimental import multihost_utils
 
         ep, it, err = -1, 0, ""
@@ -958,13 +1408,16 @@ class Checkpointer:
         return ep, it, self.load(ep, templates, verify="none")
 
     def load(self, epoch: int, templates: dict,
-             verify: str = "fast", _verified_manifest: dict | None = None
-             ) -> dict:
+             verify: str = "fast", _verified_manifest: dict | None = None,
+             _reshard_plan: ReshardPlan | None = None) -> dict:
         """Restore each named pytree into the matching template's structure
         and shardings, after verifying the file (``verify``: ``'fast'``
         default / ``'full'`` / ``'none'``).  ``_verified_manifest``: the
         recovery chain's seam — a manifest that already passed the fast +
         fingerprint check this call would otherwise repeat.
+        ``_reshard_plan`` (ISSUE 8): an elastic-resume plan to apply to
+        the loaded arrays before template restore — integrity hashes run
+        against the bytes as written; the re-layout happens after.
 
         Read failures surface as :class:`CheckpointCorruptError` even under
         ``verify='none'`` — the recovery chain must be able to classify a
@@ -998,6 +1451,10 @@ class Checkpointer:
             fname = os.path.basename(self._path(epoch))
             for key, meta in man["leaves"].items():
                 _check_leaf(fname, key, meta, arrays[key])
+        if _reshard_plan is not None:
+            # after the hash pass (CRCs cover the bytes as written),
+            # before template restore (the templates carry the NEW shapes)
+            arrays = _reshard_plan.transform_arrays(arrays)
         out = {}
         for name, template in templates.items():
             sub = {
@@ -1006,6 +1463,8 @@ class Checkpointer:
                 if k.startswith(f"{name}::")
             }
             out[name] = _restore_into(template, sub)
+        if _reshard_plan is not None:
+            self._record_reshard_apply(_reshard_plan, epoch)
         return out
 
     @staticmethod
@@ -1141,22 +1600,102 @@ def load_for_inference(directory: str, templates: dict,
 
 # -- scrubber CLI ------------------------------------------------------------
 
+def _latest_manifest(directory: str) -> tuple[int, dict]:
+    """(epoch, manifest) of the newest retained checkpoint — MANIFEST-ONLY
+    (no ``.npz`` byte is read, so this is safe against a live writer, and
+    works even when the archive itself is damaged).  Prefers the
+    ``latest.json`` pointer; falls back to the highest manifest epoch."""
+    epoch = None
+    latest = os.path.join(directory, "latest.json")
+    if os.path.exists(latest):
+        try:
+            with open(latest) as f:
+                epoch = int(json.load(f)["epoch"])
+        except (OSError, ValueError, KeyError):
+            epoch = None
+    if epoch is None or not os.path.exists(os.path.join(
+            directory, f"ckpt_e{epoch:04d}.manifest.json")):
+        epochs = sorted(
+            ep for ep in (
+                _epoch_of(f[: -len(".manifest.json")] + ".npz")
+                for f in os.listdir(directory)
+                if f.endswith(".manifest.json"))
+            if ep is not None)
+        if not epochs:
+            raise CheckpointCorruptError(
+                f"{directory}: no checkpoint manifests")
+        epoch = epochs[-1]
+    mpath = os.path.join(directory, f"ckpt_e{epoch:04d}.manifest.json")
+    try:
+        with open(mpath) as f:
+            return epoch, json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"{os.path.basename(mpath)}: unreadable manifest: {e}") from e
+
+
+def _cli_reshard_plan(args, parser) -> int:
+    """The ``--reshard-plan DIR --to-devices N`` dry run (ISSUE 8):
+    manifest-only, so it never opens the ``.npz`` and is safe to point at
+    a directory a live supervised run is writing (like ``--quarantine``'s
+    contract, but read-only).  Exit 0 when the transition plans,
+    ``EXIT_RESHARD=79`` when it is refused."""
+    from theanompi_tpu.resilience.codes import EXIT_RESHARD
+
+    if args.to_devices is None:
+        parser.error("--reshard-plan requires --to-devices N")
+    if args.to_devices < 1:
+        parser.error(f"--to-devices must be >= 1, got {args.to_devices}")
+    try:
+        epoch, manifest = _latest_manifest(args.reshard_plan)
+        fp = manifest.get("fingerprint")
+        if fp is None:
+            raise CheckpointReshardError(
+                "manifest carries no run fingerprint (pre-integrity "
+                "checkpoint)")
+        target = dict(_normalize_fp(fp))
+        target["mesh"] = dict(target.get("mesh") or {})
+        target["mesh"]["data"] = int(args.to_devices)
+        if args.strategy:
+            target["exchange"] = args.strategy
+        plan = plan_reshard(manifest, target,
+                            bucket_bytes=int(args.bucket_mb * 2**20))
+    except (CheckpointReshardError, CheckpointCorruptError) as e:
+        print(f"reshard plan REFUSED: {e}")
+        return EXIT_RESHARD
+    print(f"ckpt_e{epoch:04d} (epoch {epoch}, iteration "
+          f"{manifest.get('iteration', 0)}): {plan.describe()}")
+    print(f"plannable: resume with --resume-reshard --devices "
+          f"{args.to_devices} (or under --elastic supervision)")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     """``python -m theanompi_tpu.utils.checkpoint --verify <dir>``:
     verify every retained checkpoint against its manifest (full per-leaf
     hash by default; ``--fast`` for the cheap structural check) and report
     one line per file.  Exit 0 when everything verifies, ``EXIT_CKPT=77``
     when anything fails.  ``--quarantine`` additionally moves failed pairs
-    under ``<dir>/corrupt/`` (the default is a read-only report)."""
+    under ``<dir>/corrupt/`` (the default is a read-only report).
+
+    ``--reshard-plan <dir> --to-devices N`` (ISSUE 8): dry-run the elastic
+    re-layout of the newest checkpoint onto N devices — manifest-only,
+    printing the planned bucket re-layout and batch/LR rescale without
+    loading a byte of the checkpoint.  Exit 0 plannable / 79 refused."""
     import argparse
 
+    from theanompi_tpu.parallel.exchanger import (
+        BUCKETED_STRATEGIES,
+        STRATEGIES,
+    )
     from theanompi_tpu.resilience.codes import EXIT_CKPT
 
     p = argparse.ArgumentParser(
         prog="python -m theanompi_tpu.utils.checkpoint",
         description="Checkpoint integrity scrubber: verify every retained "
-        "checkpoint in a directory against its manifest.")
-    p.add_argument("--verify", metavar="DIR", required=True,
+        "checkpoint in a directory against its manifest, or dry-run an "
+        "elastic reshard plan from the manifest alone.")
+    p.add_argument("--verify", metavar="DIR", default=None,
                    help="checkpoint directory to scrub")
     p.add_argument("--fast", action="store_true",
                    help="structural check only (manifest + member set); "
@@ -1164,7 +1703,29 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--quarantine", action="store_true",
                    help="move failed checkpoints under DIR/corrupt/ "
                    "(default: report only)")
+    p.add_argument("--reshard-plan", metavar="DIR", default=None,
+                   help="dry-run the elastic reshard of DIR's newest "
+                   "checkpoint (manifest-only; requires --to-devices)")
+    p.add_argument("--to-devices", type=int, default=None, metavar="N",
+                   help="target data-parallel worker count for "
+                   "--reshard-plan")
+    p.add_argument("--bucket-mb", type=float, default=4.0,
+                   help="zero1 bucket size the run used (exch_bucket_mb; "
+                   "default 4.0)")
+    p.add_argument("--strategy", default=None,
+                   # real strategy names only: a typo accepted here would
+                   # print a 'plannable' verdict the actual resume rejects
+                   choices=sorted(set(STRATEGIES) | set(BUCKETED_STRATEGIES)),
+                   help="target exchange strategy for --reshard-plan "
+                   "(default: the checkpoint's own)")
     args = p.parse_args(argv)
+    if (args.verify is None) == (args.reshard_plan is None):
+        p.error("exactly one of --verify DIR or --reshard-plan DIR "
+                "is required")
+    if args.reshard_plan is not None:
+        if not os.path.isdir(args.reshard_plan):
+            p.error(f"not a directory: {args.reshard_plan}")
+        return _cli_reshard_plan(args, p)
     if not os.path.isdir(args.verify):
         p.error(f"not a directory: {args.verify}")
     # same membership rule as retention/scrub/chain: foreign files that
