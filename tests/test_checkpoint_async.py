@@ -245,9 +245,10 @@ def test_next_epoch_prefetcher_built_before_boundary(tmp_path, mesh4,
 
     monkeypatch.setattr(
         trainer_mod.BaseTrainer, "_make_prefetcher",
-        lambda self, epoch: (order.append(("prefetch", epoch)),
-                             built.append(epoch),
-                             orig_make(self, epoch))[-1])
+        lambda self, epoch, start_batch=0: (
+            order.append(("prefetch", epoch)),
+            built.append(epoch),
+            orig_make(self, epoch, start_batch))[-1])
     monkeypatch.setattr(
         trainer_mod.BaseTrainer, "validate",
         lambda self, epoch: (order.append(("validate", epoch)),

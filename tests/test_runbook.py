@@ -125,6 +125,53 @@ def test_runbook_supervised_command(tmp_path, monkeypatch,
     assert "latest.json" in os.listdir(ckpt)
 
 
+def test_runbook_data_resume_command(tmp_path, monkeypatch,
+                                     subproc_compile_cache):
+    """RUNBOOK step 5d's mid-epoch kill/resume rehearsal (ISSUE 10): the
+    exact flag set BASELINE.md documents — `--rule-set
+    checkpoint_every_n_iters=N` under `--supervise` with
+    THEANOMPI_DATA_TRACE — killed one step INTO epoch 1, restarted, and
+    the trace audit the runbook describes holds: one line per completed
+    step, no batch replayed, none skipped."""
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=8")
+    monkeypatch.setenv("JAX_THREEFRY_PARTITIONABLE", "true")
+    monkeypatch.setenv("PYTHONPATH",
+                       repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    trace = str(tmp_path / "trace")
+    monkeypatch.setenv("THEANOMPI_DATA_TRACE", trace)
+    monkeypatch.setenv("THEANOMPI_FAULT_PLAN", "step:kill@3@1")
+    assert sys.executable
+    ckpt = str(tmp_path / "ckpt")
+    rc = launcher.main([
+        "--rule", "BSP", "--devices", "4",
+        "--modelfile", "theanompi_tpu.models.wide_resnet",
+        "--modelclass", "WideResNet",
+        "--set", "depth=10", "--set", "widen=1", "--set", "batch_size=4",
+        "--set", "image_size=8", "--set", "n_train=32", "--set", "n_val=16",
+        "--set", "n_epochs=2", "--set", "precision='fp32'",
+        "--rule-set", "checkpoint_every_n_iters=1",
+        # the runbook's determinism note: synchronous cadence saves
+        "--rule-set", "checkpoint_async=False",
+        "--checkpoint-dir", ckpt,
+        "--compile-cache-dir", subproc_compile_cache,
+        "--supervise", "--max-restarts", "3", "--backoff-base", "0.1",
+        "--quiet",
+    ])
+    assert rc == 0
+    art = json.load(open(os.path.join(ckpt, "resilience.json")))
+    assert [a["cause"] for a in art["attempts"]] == ["crash", "clean"]
+    # the runbook's trace audit: gap-free, duplicate-free consumed-step
+    # sequence across both attempts (2 epochs x 2 steps)
+    lines = [tuple(int(v) for v in l.split())
+             for l in open(trace) if l.strip()]
+    assert lines == [(0, 0), (0, 1), (1, 0), (1, 1)]
+
+
 def test_runbook_exchange_bench_command(tmp_path):
     """The RUNBOOK's exchange-strategy comparison sidebar: the exact
     --exchange-bench CLI must run and emit the per-strategy artifact

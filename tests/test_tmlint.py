@@ -51,7 +51,8 @@ def test_package_runs_clean_under_the_full_rule_set():
 def test_registry_has_the_advertised_rules():
     names = set(core.all_rules())
     assert {"wall", "swallow", "np-load", "donated-escape", "host-sync",
-            "jit-nondet", "exit-code", "import-dag"} <= names
+            "jit-nondet", "exit-code", "import-dag",
+            "data-determinism"} <= names
 
 
 # ---------------------------------------------------------------------------
@@ -320,6 +321,61 @@ def test_exit_code_source_module_is_exempt(tmp_path):
         ["exit-code"],
         rel="theanompi_tpu/resilience/codes.py")
     assert not active, active
+
+
+# ---------------------------------------------------------------------------
+# data-determinism (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+_DATA_REL = "theanompi_tpu/models/data/fx.py"
+
+
+def test_data_determinism_fires_only_under_models_data(tmp_path):
+    src = ("import numpy as np\n"
+           "def order():\n"
+           "    return np.random.permutation(8)\n")
+    active, _ = run_src(tmp_path, src, ["data-determinism"], rel=_DATA_REL)
+    assert len(active) == 1, active
+    assert "np.random.permutation()" in active[0].message
+    assert active[0].severity == "error"
+    # the same draw OUTSIDE the data plane is this rule's non-concern
+    # (jit-nondet still owns jitted scopes there)
+    elsewhere, _ = run_src(tmp_path, src, ["data-determinism"],
+                           rel="theanompi_tpu/parallel/fx.py")
+    assert not elsewhere, elsewhere
+
+
+def test_data_determinism_allows_derive_seed_keyed_randomstate(tmp_path):
+    """The repo's sanctioned idiom — a RandomState keyed on
+    derive_seed(..., epoch, position) — must pass untouched."""
+    active, _ = run_src(
+        tmp_path,
+        "import numpy as np\n"
+        "from theanompi_tpu.models.data.base import derive_seed\n"
+        "def order(seed, epoch):\n"
+        "    rng = np.random.RandomState("
+        "derive_seed('shuffle', seed, epoch))\n"
+        "    return rng.permutation(8)\n",
+        ["data-determinism"], rel=_DATA_REL)
+    assert not active, active
+
+
+def test_data_determinism_flags_unseeded_ctor_and_bare_random(tmp_path):
+    """An unseeded RandomState(), global random.seed() and a bare
+    random.random() draw are all order-dependent state a checkpoint
+    cannot capture — each is its own finding."""
+    active, _ = run_src(
+        tmp_path,
+        "import numpy as np\n"
+        "import random\n"
+        "def f():\n"
+        "    rng = np.random.RandomState()\n"
+        "    random.seed(0)\n"
+        "    return rng, random.random()\n",
+        ["data-determinism"], rel=_DATA_REL)
+    lines = sorted(f.line for f in active)
+    assert lines == [4, 5, 6], active
+    assert any("no seed" in f.message for f in active)
 
 
 # ---------------------------------------------------------------------------

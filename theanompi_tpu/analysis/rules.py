@@ -19,6 +19,9 @@ incident history:
 - ``exit-code`` — PR 4's exit-code drift: bare 70/75/76/77/78/79 literals
   outside ``resilience/codes.py`` re-create the duplicated contract that
   module exists to kill.
+- ``data-determinism`` — ISSUE 10's resume contract: one unseeded
+  ``np.random.*`` draw in ``models/data/`` makes batch content depend on
+  call order, which a mid-epoch cursor fast-forward cannot reproduce.
 
 Every rule is heuristic where it must be (static analysis cannot prove a
 buffer is donated); the escape hatch is the suppression grammar in
@@ -639,3 +642,67 @@ class ExitCodeRule(Rule):
                 for side in (node.left, *node.comparators):
                     for const in self._literals_in(side):
                         yield from emit(const, "a comparison")
+
+
+# ---------------------------------------------------------------------------
+# data-plane determinism
+# ---------------------------------------------------------------------------
+
+#: the tree whose batch content must be a pure function of
+#: (seed, epoch, position) — ISSUE 10's cursor-exact resume contract
+DATA_PLANE_PREFIX = "theanompi_tpu/models/data/"
+
+
+@register
+class DataDeterminismRule(Rule):
+    """Unseeded randomness anywhere in the data plane.
+
+    Mid-epoch resume fast-forwards by cursor arithmetic instead of
+    replaying consumed batches, which is only sound if every batch is
+    recomputable in isolation from ``(seed, epoch, position)``.  One draw
+    from the global numpy RNG (or an unseeded ``RandomState()``) makes
+    batch content depend on call order and process history — state a
+    checkpoint cannot capture, so the resumed run silently diverges.
+    Derive per-call seeds with ``models.data.base.derive_seed`` and feed
+    them to a local ``np.random.RandomState``.
+    """
+
+    name = "data-determinism"
+    severity = SEV_ERROR
+    description = ("unseeded np.random.* / global RNG under models/data/ "
+                   "breaks cursor-exact mid-epoch resume")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        if not src.rel.startswith(DATA_PLANE_PREFIX):
+            return
+        has_bare_random = any(
+            isinstance(n, ast.Import)
+            and any(a.name == "random" for a in n.names)
+            for n in ast.walk(src.tree))
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            f = node.func
+            v = f.value
+            what = None
+            if (isinstance(v, ast.Attribute) and v.attr == "random"
+                    and isinstance(v.value, ast.Name)
+                    and v.value.id in ("np", "numpy")):
+                if f.attr not in _NP_RANDOM_OK:
+                    what = f"np.random.{f.attr}()"
+                elif not node.args and not node.keywords:
+                    what = f"np.random.{f.attr}() with no seed"
+            elif (has_bare_random and isinstance(v, ast.Name)
+                  and v.id == "random"):
+                # random.seed() is flagged too: mutating the global RNG in
+                # the data plane is the order-dependence this rule exists
+                # to catch, not an exemption from it.
+                what = f"random.{f.attr}()"
+            if what is not None:
+                yield self.finding(
+                    src, node.lineno, node.col_offset,
+                    f"{what} in the data plane — batch content must be a "
+                    f"pure function of (seed, epoch, position) or mid-epoch "
+                    f"resume diverges; use np.random.RandomState("
+                    f"derive_seed(...)) instead")

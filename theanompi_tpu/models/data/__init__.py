@@ -6,7 +6,13 @@ Reference (unverified — SURVEY.md §2.1): ``theanompi/models/data/`` —
 overlapping augmentation with GPU compute, the "para_load" protocol).
 """
 
-from theanompi_tpu.models.data.base import Dataset, SyntheticDataset
+from theanompi_tpu.models.data.base import (
+    Dataset,
+    SyntheticDataset,
+    derive_seed,
+    set_data_hooks,
+)
 from theanompi_tpu.models.data.cifar10 import Cifar10Data
 
-__all__ = ["Dataset", "SyntheticDataset", "Cifar10Data"]
+__all__ = ["Dataset", "SyntheticDataset", "Cifar10Data", "derive_seed",
+           "set_data_hooks"]

@@ -9,7 +9,9 @@ same via ``file_batch_size`` bookkeeping; SURVEY.md §2.3, unverified).
 
 from __future__ import annotations
 
+import hashlib
 import sys
+import threading
 import time
 
 import numpy as np
@@ -20,6 +22,71 @@ class DataReadError(RuntimeError):
     terminal error loaders raise instead of leaking the first IOError
     (under supervision this is a restartable crash, and the message says
     which file and how many attempts)."""
+
+
+def derive_seed(*parts) -> int:
+    """Derive a 31-bit numpy seed from structured parts, stably.
+
+    The one seed-derivation helper every dataset uses (ISSUE 10 satellite;
+    replaces the scattered ``hash((seed, epoch)) % (2**31)`` idiom).  Keyed
+    draws — ``derive_seed("augment", seed, epoch, batch_index)`` — make any
+    batch recomputable in isolation, which mid-epoch cursor fast-forward
+    depends on.  Built on sha256 of the ``repr`` of the parts, so the value
+    is identical across processes, platforms and interpreter restarts
+    (``hash`` of a str/bytes part would depend on ``PYTHONHASHSEED``), and
+    distinct part *positions* never collide (parts are joined with an
+    unambiguous separator, not concatenated).
+    """
+    text = "\x1f".join(repr(p) for p in parts)
+    digest = hashlib.sha256(text.encode("utf-8")).digest()
+    return int.from_bytes(digest[:8], "little") % (2**31)
+
+
+# -- data-plane hooks (telemetry + deterministic fault injection) -------------
+# Datasets are constructed by models, far from the trainer's telemetry and
+# fault plan — so the trainer publishes both through this module-level
+# registration instead of threading them through every Dataset __init__.
+# One process, one data plane: pool workers (separate spawned processes)
+# intentionally see no hooks.
+
+_HOOKS_LOCK = threading.Lock()
+_DATA_TELEMETRY = None
+_DATA_FAULT_PLAN = None
+_READ_ORDINAL = 0
+#: set → any in-progress ``data:stall`` injection returns (tests use this to
+#: unwedge the loader thread before closing the Prefetcher)
+_STALL_RELEASE = threading.Event()
+
+
+def set_data_hooks(telemetry=None, fault_plan=None) -> None:
+    """Install (or clear, with Nones) the process-wide data-plane hooks.
+
+    ``telemetry`` receives a ``data.retries`` counter event per retried
+    read; ``fault_plan`` enables the ``data:torn_read@i`` /
+    ``data:stall@i`` sites inside :func:`read_with_retry`, where ``i`` is
+    the process-global read ordinal (every ``read_with_retry`` call counts,
+    in call order).  Also resets the read ordinal so fault indices are
+    deterministic per (re)installation.
+    """
+    global _DATA_TELEMETRY, _DATA_FAULT_PLAN, _READ_ORDINAL
+    with _HOOKS_LOCK:
+        _DATA_TELEMETRY = telemetry
+        _DATA_FAULT_PLAN = fault_plan
+        _READ_ORDINAL = 0
+        _STALL_RELEASE.clear()
+
+
+def release_data_stalls() -> None:
+    """Unblock any thread wedged in an injected ``data:stall`` (tests)."""
+    _STALL_RELEASE.set()
+
+
+def _next_read_ordinal() -> int:
+    global _READ_ORDINAL
+    with _HOOKS_LOCK:
+        i = _READ_ORDINAL
+        _READ_ORDINAL += 1
+        return i
 
 
 def read_with_retry(fn, what: str, retries: int = 4,
@@ -33,14 +100,42 @@ def read_with_retry(fn, what: str, retries: int = 4,
     ``ValueError`` for a torn partial read are retried ``retries`` times
     with doubling ``backoff_s``; exhaustion raises the typed
     :class:`DataReadError` carrying the last cause.
+
+    ISSUE 10 satellite: every retry lands in the ``data.retries`` telemetry
+    counter (when hooks are installed — retries used to be stderr-only,
+    invisible to rank-0 aggregation), and the ``data:torn_read@i`` /
+    ``data:stall@i`` fault sites fire here, making both paths
+    deterministically testable.
     """
     retries = max(1, int(retries))
+    plan, tel = _DATA_FAULT_PLAN, _DATA_TELEMETRY
+    ordinal = _next_read_ordinal() if plan is not None else -1
     last: Exception | None = None
     for attempt in range(1, retries + 1):
+        injected: Exception | None = None
+        if plan is not None:
+            action = plan.fire("data", ordinal)
+            if action == "stall":
+                # a wedged read (dead NFS mount): produce nothing — the
+                # consumer-side witness is the Prefetcher's stall_timeout
+                while not _STALL_RELEASE.wait(0.05):
+                    pass
+                from theanompi_tpu.resilience.faults import FaultInjected
+
+                raise FaultInjected(f"injected data stall reading {what}")
+            if action == "torn_read":
+                # the torn-partial-read shape numpy raises for a file that
+                # changed size underneath it — retried like the real thing
+                injected = ValueError(
+                    f"injected torn read of {what} (fault plan)")
         try:
+            if injected is not None:
+                raise injected
             return fn()
         except (OSError, ValueError) as e:
             last = e
+            if tel is not None:
+                tel.count("data.retries", emit=True, what=what)
             if attempt < retries:
                 print(f"data: read of {what} failed "
                       f"(attempt {attempt}/{retries}): {e}; retrying",
@@ -52,7 +147,25 @@ def read_with_retry(fn, what: str, retries: int = 4,
 
 
 class Dataset:
-    """Duck-typed dataset: n_train/n_val counts + batch iterators."""
+    """Duck-typed dataset: n_train/n_val counts + batch iterators.
+
+    Iterator-state contract (ISSUE 10): every dataset is a checkpointable,
+    deterministic component.  ``train_batches`` accepts ``start_batch`` —
+    the global batch cursor to fast-forward to — and MUST reproduce, from
+    cursor ``k`` onward, exactly the batches an uninterrupted epoch-``epoch``
+    iteration would have yielded from position ``k`` (bit-equal, including
+    augmentation noise).  That requires all randomness to be keyed on
+    ``derive_seed(..., epoch, position)``, never drawn from a stream whose
+    phase depends on how many batches were already produced.
+
+    ``state()``/``set_state()`` carry whatever position the (epoch, cursor)
+    pair the trainer checkpoints does NOT determine — per-source window
+    cursors, mixture weights (see ``stream.py``).  Datasets whose iteration
+    is a pure function of (epoch, cursor, seed) are stateless here: the
+    defaults return/accept ``{}``.  The dict must be JSON-serializable and
+    device-count-independent (it rides in the checkpoint manifest and must
+    survive an elastic mesh8→4 resume unchanged).
+    """
 
     n_train: int
     n_val: int
@@ -65,11 +178,19 @@ class Dataset:
     def n_val_batches(self, batch_size: int) -> int:
         return self.n_val // batch_size
 
-    def train_batches(self, batch_size: int, epoch: int, seed: int = 0):
+    def train_batches(self, batch_size: int, epoch: int, seed: int = 0,
+                      start_batch: int = 0):
         raise NotImplementedError
 
     def val_batches(self, batch_size: int):
         raise NotImplementedError
+
+    def state(self) -> dict:
+        """Checkpointable iterator state beyond the (epoch, cursor) pair."""
+        return {}
+
+    def set_state(self, state: dict) -> None:
+        """Restore :meth:`state` output (no-op for stateless datasets)."""
 
     def cleanup(self) -> None:
         pass
@@ -87,13 +208,23 @@ class ArrayDataset(Dataset):
         self.n_classes = n_classes
         self.augment_fn = augment_fn
 
-    def train_batches(self, batch_size, epoch, seed=0):
-        rng = np.random.RandomState(hash((seed, epoch)) % (2**31))
-        order = rng.permutation(self.n_train)
-        for i in range(self.n_train_batches(batch_size)):
+    def epoch_order(self, epoch, seed=0):
+        """The epoch's sample permutation — a pure function of (seed,
+        epoch), so a cursor fast-forward re-derives it without replay."""
+        rng = np.random.RandomState(derive_seed("shuffle", seed, epoch))
+        return rng.permutation(self.n_train)
+
+    def train_batches(self, batch_size, epoch, seed=0, start_batch=0):
+        order = self.epoch_order(epoch, seed)
+        for i in range(int(start_batch), self.n_train_batches(batch_size)):
             idx = order[i * batch_size : (i + 1) * batch_size]
             x = self.x_train[idx]
             if self.augment_fn is not None:
+                # per-batch derived rng (NOT the permutation's stream):
+                # batch i's augmentation is recomputable in isolation, so
+                # resuming at cursor k reproduces batch k bit-equal
+                rng = np.random.RandomState(
+                    derive_seed("augment", seed, epoch, i))
                 x = self.augment_fn(x, rng)
             yield {"x": x, "y": self.y_train[idx]}
 
@@ -191,10 +322,10 @@ class SyntheticSequenceDataset(Dataset):
         self._val = gen(n_val, np.random.RandomState(seed + 2))
         self.n_train, self.n_val = n_train, n_val
 
-    def train_batches(self, batch_size, epoch, seed=0):
-        rng = np.random.RandomState(hash((seed, epoch)) % (2**31))
+    def train_batches(self, batch_size, epoch, seed=0, start_batch=0):
+        rng = np.random.RandomState(derive_seed("shuffle", seed, epoch))
         order = rng.permutation(self.n_train)
-        for i in range(self.n_train // batch_size):
+        for i in range(int(start_batch), self.n_train // batch_size):
             idx = order[i * batch_size : (i + 1) * batch_size]
             s = self._train[idx]
             yield {"x": s[:, :-1], "y": s[:, 1:]}

@@ -25,7 +25,11 @@ import os
 
 import numpy as np
 
-from theanompi_tpu.models.data.base import Dataset, read_with_retry
+from theanompi_tpu.models.data.base import (
+    Dataset,
+    derive_seed,
+    read_with_retry,
+)
 
 # ImageNet channel means in [0,255] RGB (the reference subtracted a stored
 # per-pixel mean image; per-channel is the modern equivalent)
@@ -133,6 +137,7 @@ class _ShardSet:
                 what=p))
             for p in self.x_files
         ]
+        self.lens = lens  # per-shard counts: cursor fast-forward arithmetic
         self.n = sum(lens)
         self.max_len = max(lens)
 
@@ -169,6 +174,8 @@ class _SyntheticShards:
         self.shard_size = shard_size
         self.seed = seed
         self.n_shards = (n + shard_size - 1) // shard_size
+        self.lens = [min(shard_size, n - i * shard_size)
+                     for i in range(self.n_shards)]
         self._pattern_cache: dict[int, np.ndarray] = {}
 
     def _pattern(self, cls: int) -> np.ndarray:
@@ -297,24 +304,31 @@ class ImageNetData(Dataset):
             self._shm_pool = None
 
     # -- iteration -----------------------------------------------------------
-    def _augmented_shards(self, src, order, train: bool, rng):
+    def _augmented_shards(self, src, tagged, train: bool, epoch=0, seed=0):
         """-> iterator of per-shard (x, y), augmented for train.
 
-        ``loader_workers > 0`` (train only) fans shards over a fork pool
-        running :func:`_augment_worker` — load + C crop/mirror + shuffle
-        all happen in the workers, ``imap`` keeps shard order, and the
-        per-shard seeds drawn here make the stream deterministic (a
-        DIFFERENT deterministic stream than the inline path, which draws
-        its augmentation from one sequential rng).
+        ``tagged`` is ``[(pos, shard_index), ...]`` — ``pos`` is the
+        shard's position in the epoch's shard order, which keys that
+        shard's augmentation seed (``derive_seed("augment", seed, epoch,
+        pos)``), so any shard is recomputable in isolation for a cursor
+        fast-forward.  ``loader_workers > 0`` (train only) fans shards over
+        a spawn pool — load + C crop/mirror + shuffle all happen in the
+        workers, the ring keeps shard order, and the worker performs the
+        exact op sequence of the inline branch below on the same keyed
+        seed, so the pool and inline paths produce ONE identical
+        deterministic stream (locked by test).
         """
         if train and self.loader_workers > 0:
-            seeds = rng.randint(0, 2**31 - 1, size=len(order))
-            tasks = [(src.spec(int(i)), int(s))
-                     for i, s in zip(order, seeds)]
+            tasks = [(src.spec(int(i)),
+                      derive_seed("augment", seed, epoch, int(pos)))
+                     for pos, i in tagged]
             yield from self._pool().run(tasks)
             return
-        for x, y in src.iter_shards(order):
+        for pos, i in tagged:
+            x, y = src.load(int(i))
             if train:
+                rng = np.random.RandomState(
+                    derive_seed("augment", seed, epoch, int(pos)))
                 x = random_crop_mirror(x, self.image_size, rng)
                 within = rng.permutation(len(x))
                 x, y = x[within], y[within]
@@ -322,15 +336,36 @@ class ImageNetData(Dataset):
                 x = center_crop(x, self.image_size)
             yield x, y
 
-    def _batches(self, src, n_shards, batch_size, train: bool, rng=None):
+    def _batches(self, src, n_shards, batch_size, train: bool, epoch=0,
+                 seed=0, start_batch=0):
         """Shuffled-shard iteration with a rolling remainder buffer, so exact
         constant-size batches are emitted across shard boundaries (the
-        reference's file_batch_size/n_subb bookkeeping)."""
-        order = rng.permutation(n_shards) if train else np.arange(n_shards)
+        reference's file_batch_size/n_subb bookkeeping).
+
+        ``start_batch`` fast-forwards by cursor arithmetic: whole shards
+        that lie entirely before sample offset ``start_batch * batch_size``
+        are never read or augmented (their keyed seeds make that sound),
+        and the first surviving shard is trimmed by the residual — the
+        yielded stream is the exact tail an uninterrupted epoch would have
+        produced from that batch onward.
+        """
+        if train:
+            order = np.random.RandomState(
+                derive_seed("shards", seed, epoch)).permutation(n_shards)
+        else:
+            order = np.arange(n_shards)
+        tagged = list(enumerate(order))
+        skip = int(start_batch) * batch_size  # samples already consumed
+        while tagged and skip >= src.lens[int(tagged[0][1])]:
+            skip -= src.lens[int(tagged[0][1])]
+            tagged = tagged[1:]
         buf_x: list[np.ndarray] = []
         buf_y: list[np.ndarray] = []
         have = 0
-        for x, y in self._augmented_shards(src, order, train, rng):
+        for x, y in self._augmented_shards(src, tagged, train, epoch, seed):
+            if skip:
+                x, y = x[skip:], y[skip:]
+                skip = 0
             buf_x.append(x)
             buf_y.append(y)
             have += len(x)
@@ -343,10 +378,11 @@ class ImageNetData(Dataset):
                 have -= batch_size
         # ragged tail dropped (constant shapes under jit)
 
-    def train_batches(self, batch_size: int, epoch: int, seed: int = 0):
-        rng = np.random.RandomState(hash((seed, epoch)) % (2**31))
+    def train_batches(self, batch_size: int, epoch: int, seed: int = 0,
+                      start_batch: int = 0):
         return self._batches(self._train, self._train_shards, batch_size,
-                             train=True, rng=rng)
+                             train=True, epoch=epoch, seed=seed,
+                             start_batch=start_batch)
 
     def val_batches(self, batch_size: int):
         return self._batches(self._val, self._val_shards, batch_size,

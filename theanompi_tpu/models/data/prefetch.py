@@ -46,11 +46,21 @@ class Prefetcher:
     :class:`PrefetchStallError`.  ``fault_plan`` enables the deterministic
     ``prefetch:stall@N`` / ``prefetch:raise@N`` injection sites inside the
     worker (N = source batch ordinal).
+
+    Checkpointable position (ISSUE 10): ``start_batch`` declares the global
+    batch index of the FIRST item ``it`` will yield (the caller built the
+    source fast-forwarded to that cursor), so fault-site ordinals stay
+    global batch indices across a resume.  :meth:`state` reports
+    ``consumed`` — the index of the first batch the *consumer* has not been
+    handed yet.  Batches sitting in the queue (produced, possibly
+    device-resident, but never returned from ``__next__``) are excluded by
+    construction: a restore from this snapshot resumes at the first
+    unconsumed batch, replaying nothing and skipping nothing.
     """
 
     def __init__(self, it, mesh=None, depth: int = 2, spec=None,
                  telemetry=None, stall_timeout: float | None = None,
-                 fault_plan=None):
+                 fault_plan=None, start_batch: int = 0):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         if stall_timeout is not None and stall_timeout <= 0:
@@ -66,6 +76,7 @@ class Prefetcher:
         self._stall_timeout = stall_timeout
         self._err: BaseException | None = None
         self._stop = threading.Event()
+        self._consumed = int(start_batch)
 
         def put(item) -> bool:
             """put that gives up when the consumer closed us."""
@@ -79,7 +90,7 @@ class Prefetcher:
 
         def work():
             try:
-                for i, item in enumerate(it):
+                for i, item in enumerate(it, start=int(start_batch)):
                     if fault_plan is not None:
                         action = fault_plan.fire("prefetch", i)
                         if action == "stall":
@@ -145,7 +156,14 @@ class Prefetcher:
         if tel is not None:
             tel.emit_span("prefetch.dequeue", t0,
                           time.perf_counter() - t0, qsize=self._q.qsize())
+        self._consumed += 1
         return item
+
+    def state(self) -> dict:
+        """Restart snapshot: ``consumed`` is the global index of the first
+        batch the consumer has NOT received — in-flight queued batches are
+        not counted, so restoring here neither replays nor skips data."""
+        return {"consumed": self._consumed}
 
     def close(self) -> None:
         """Release the worker, drop queued (device-resident) batches, and
@@ -184,11 +202,12 @@ class Prefetcher:
 
 
 def prefetch(it, mesh=None, depth: int = 2, spec=None, telemetry=None,
-             stall_timeout: float | None = None, fault_plan=None):
+             stall_timeout: float | None = None, fault_plan=None,
+             start_batch: int = 0):
     """``depth=0`` disables prefetching (pass-through), else wraps in a
     :class:`Prefetcher`."""
     if depth == 0:
         return it
     return Prefetcher(it, mesh=mesh, depth=depth, spec=spec,
                       telemetry=telemetry, stall_timeout=stall_timeout,
-                      fault_plan=fault_plan)
+                      fault_plan=fault_plan, start_batch=start_batch)

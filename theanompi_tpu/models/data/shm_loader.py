@@ -48,6 +48,19 @@ def _worker(task_q, result_q, shm_name, slot_nbytes, image_size):
             if task is None:
                 return
             idx, spec, seed, slot = task
+            if spec[0] == "tokens":
+                # token mode (stream.py warm-load): read one flat int32
+                # token shard into the slot — no augmentation, no labels
+                from theanompi_tpu.models.data.stream import (
+                    load_token_shard,
+                )
+
+                toks = load_token_shard(spec[1])
+                out = np.ndarray(toks.shape, np.int32,
+                                 buffer=shm.buf[slot * slot_nbytes:])
+                out[:] = toks
+                result_q.put((idx, slot, toks.shape, "int32", None))
+                continue
             x, y = _load_from_spec(spec)
             rng = np.random.RandomState(seed)
             x = random_crop_mirror(x, image_size, rng)
@@ -58,7 +71,7 @@ def _worker(task_q, result_q, shm_name, slot_nbytes, image_size):
             out[:] = x
             # lint: donated-escape-ok — y is fancy-indexed above (y[per]):
             # a fresh host-owned array, never a device-buffer view
-            result_q.put((idx, slot, x.shape, np.asarray(y)))
+            result_q.put((idx, slot, x.shape, "uint8", np.asarray(y)))
     finally:
         shm.close()
 
@@ -68,20 +81,27 @@ class ShmShardPool:
     (x, y) shards in order; ``close()`` tears the workers down.
 
     ``tasks``: list of (spec, seed) with specs from
-    ``_ShardSet.spec``/``_SyntheticShards.spec``.  Yielded ``x`` arrays are
-    fresh copies (the ring slot is recycled immediately).  One epoch at a
-    time: a second ``run`` while one is active raises (close the first
-    generator — the prefetcher does).
+    ``_ShardSet.spec``/``_SyntheticShards.spec``, or ``("tokens", path)``
+    specs (token shards for ``stream.py`` — yielded as (int32 tokens,
+    None)).  Yielded ``x`` arrays are fresh copies (the ring slot is
+    recycled immediately).  One epoch at a time: a second ``run`` while
+    one is active raises (close the first generator — the prefetcher
+    does).
+
+    ``slot_nbytes`` overrides the image-shard slot-size formula for
+    non-image payloads (the token mode).
     """
 
     def __init__(self, image_size: int, shard_size: int, workers: int,
-                 slots: int | None = None, ctx_method: str = "spawn"):
+                 slots: int | None = None, ctx_method: str = "spawn",
+                 slot_nbytes: int | None = None):
         from multiprocessing import shared_memory
 
         self.image_size = image_size
         self.workers = max(1, workers)
         self.slots = slots or 2 * self.workers
-        self.slot_nbytes = shard_size * image_size * image_size * 3
+        self.slot_nbytes = (slot_nbytes if slot_nbytes is not None
+                            else shard_size * image_size * image_size * 3)
         self._shm = shared_memory.SharedMemory(
             create=True, size=max(1, self.slots * self.slot_nbytes))
         ctx = mp.get_context(ctx_method)
@@ -146,11 +166,11 @@ class ShmShardPool:
             try:
                 for want in range(len(tasks)):
                     while want not in pending:
-                        idx, slot, shape, y = self._get_result()
-                        pending[idx] = (slot, shape, y)
-                    slot, shape, y = pending.pop(want)
+                        idx, slot, shape, dt, y = self._get_result()
+                        pending[idx] = (slot, shape, dt, y)
+                    slot, shape, dt, y = pending.pop(want)
                     view = np.ndarray(
-                        shape, np.uint8,
+                        shape, np.dtype(dt),
                         buffer=self._shm.buf[slot * self.slot_nbytes:])
                     x = view.copy()  # the slot is recycled right after
                     del view  # shm.buf views must die before close/unlink

@@ -1,0 +1,329 @@
+"""Streaming tokenized LM dataset with checkpointable mixture cursors.
+
+The in-memory per-epoch-shuffle :class:`~theanompi_tpu.models.data.base.
+Dataset` model does not fit LM-scale corpora: a streaming corpus has no
+natural epoch, is read as sharded token files too large to shuffle whole,
+and is usually a *mixture* of sources (web / code / books) sampled by
+weight.  This module supplies that shape under the ISSUE 10 iterator-state
+contract:
+
+- **Sources**: each source is an ordered list of 1-D token shards
+  (``*.npy`` int arrays, read via ``read_with_retry``) or a deterministic
+  synthetic token stream (zero-egress stand-in with learnable bigram
+  structure).  A source is addressed in fixed non-overlapping *windows* of
+  ``seq_len + 1`` tokens (targets are inputs shifted by one); the ragged
+  tail of each shard is dropped so the window→shard mapping never depends
+  on neighbouring shards.
+- **Mixture**: each sample draws its source from the mixture weights via
+  ``derive_seed("mix", seed, epoch, global_sample_index)`` — a pure
+  function of the sample's position, never of iteration history or of the
+  batch size (so an elastic resume that re-batches the stream keeps the
+  identical flat sample order).
+- **Cursors**: every source advances a window cursor as its windows are
+  consumed; cursors carry *across* nominal epochs (the stream continues —
+  it does not rewind), which makes them genuinely stateful.
+  :meth:`StreamTokenDataset.state` returns the start-of-epoch cursor base
+  plus the live mixture weights; a mid-epoch resume restores that base and
+  fast-forwards by replaying only the cheap integer mixture *choices* for
+  the consumed batches — no token is ever re-read, no window replayed or
+  skipped.  The state is device-count-independent: an elastic mesh8→4
+  resume recomputes its batch cursor from the sample cursor and consumes
+  the identical remaining window order.
+
+Config keys (all optional): ``seq_len``; ``stream_sources`` — list of
+``{"name", "weight", "path"}`` (dir of token shards) or ``{"name",
+"weight", "tokens", "vocab", "seed"}`` (synthetic); ``n_train`` — nominal
+sequences per epoch (streams need a nominal epoch length for the trainer's
+epoch loop); ``n_val``; ``loader_workers`` — > 0 warm-loads file-source
+shards in parallel through the :class:`ShmShardPool` token mode.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from theanompi_tpu.models.data.base import (
+    Dataset,
+    derive_seed,
+    read_with_retry,
+)
+
+
+def load_token_shard(path: str) -> np.ndarray:
+    """One token shard as a flat int32 array (bounded-retry read)."""
+    return read_with_retry(
+        lambda: np.asarray(np.load(path)).astype(np.int32).ravel(),
+        what=path)
+
+
+class _FileTokenSource:
+    """Sharded on-disk token stream with window addressing."""
+
+    def __init__(self, name: str, path: str, seq_len: int):
+        self.name = name
+        self.window_len = seq_len + 1
+        shards = sorted(f for f in os.listdir(path)
+                        if f.endswith(".npy"))
+        if not shards:
+            raise FileNotFoundError(f"no .npy token shards under {path}")
+        self.shard_paths = [os.path.join(path, f) for f in shards]
+        # headers only (mmap): counts for the window→shard map, no payload
+        lens = [int(read_with_retry(
+            lambda p=p: np.load(p, mmap_mode="r").shape[0], what=p))
+            for p in self.shard_paths]
+        self.shard_windows = [n // self.window_len for n in lens]
+        self.n_windows = sum(self.shard_windows)
+        if self.n_windows == 0:
+            raise ValueError(
+                f"source {name!r}: no shard holds a full window "
+                f"({self.window_len} tokens)")
+        self.vocab_hint = None  # unknown without reading payloads
+        self._cache: dict[int, np.ndarray] = {}
+        self._cache_order: list[int] = []
+
+    def cache_shard(self, j: int, toks: np.ndarray) -> None:
+        """Install a pre-loaded shard (the pool warm-load path)."""
+        self._cache[j] = toks
+        self._cache_order.append(j)
+
+    def _shard(self, j: int) -> np.ndarray:
+        toks = self._cache.get(j)
+        if toks is None:
+            toks = load_token_shard(self.shard_paths[j])
+            self.cache_shard(j, toks)
+            # cursor access is sequential per source: keep a few shards
+            while len(self._cache_order) > 4:
+                self._cache.pop(self._cache_order.pop(0), None)
+        return toks
+
+    def window(self, w: int) -> np.ndarray:
+        w %= self.n_windows
+        for j, nw in enumerate(self.shard_windows):
+            if w < nw:
+                start = w * self.window_len
+                return self._shard(j)[start:start + self.window_len]
+            w -= nw
+        raise AssertionError("unreachable: window index out of range")
+
+
+class _SyntheticTokenSource:
+    """Deterministic procedural token stream (learnable sparse bigram).
+
+    Same structure as the large-vocab branch of
+    ``SyntheticSequenceDataset``: every token has 32 successors at
+    ``(a*cur + c + j*j) % vocab`` drawn from one peaked categorical — O(1)
+    memory, perplexity can drop well below vocab.  Window ``w`` is a pure
+    function of (seed, w): chains are generated per-window from a keyed
+    rng, so any window is recomputable in isolation.
+    """
+
+    def __init__(self, name: str, n_tokens: int, vocab: int, seed: int,
+                 seq_len: int):
+        self.name = name
+        self.window_len = seq_len + 1
+        self.vocab_hint = vocab
+        self.vocab = vocab
+        self.n_windows = max(1, int(n_tokens) // self.window_len)
+        rng = np.random.RandomState(derive_seed("stream-synth", seed, name))
+        self._a = 2 * rng.randint(1, max(2, vocab // 2)) + 1
+        self._c = rng.randint(vocab)
+        wl = np.sort(rng.randn(32) * 2.0)[::-1]
+        w = np.exp(wl) / np.exp(wl).sum()
+        self._cdf = w.cumsum()
+        self._seed = seed
+
+    def window(self, w: int) -> np.ndarray:
+        w %= self.n_windows
+        r = np.random.RandomState(derive_seed("window", self._seed,
+                                              self.name, w))
+        out = np.zeros(self.window_len, np.int32)
+        out[0] = r.randint(0, self.vocab)
+        j2 = np.arange(32, dtype=np.int64) ** 2
+        for t in range(self.window_len - 1):
+            j = min(int((r.rand() > self._cdf).sum()), 31)
+            out[t + 1] = (self._a * int(out[t]) + self._c + j2[j]) % self.vocab
+        return out
+
+
+class StreamTokenDataset(Dataset):
+    """Multi-source windowed token stream feeding ``transformer_lm``."""
+
+    def __init__(self, config: dict | None = None):
+        config = config or {}
+        self.seq_len = int(config.get("seq_len", 128))
+        self.loader_workers = int(config.get("loader_workers", 0))
+        specs = config.get("stream_sources")
+        if not specs:
+            # zero-egress default: a two-source synthetic mixture, so the
+            # mixture/cursor machinery is exercised even out of the box
+            vocab = int(config.get("vocab", 256))
+            specs = [
+                {"name": "syn-a", "weight": 0.75, "tokens": 65536,
+                 "vocab": vocab, "seed": 11},
+                {"name": "syn-b", "weight": 0.25, "tokens": 65536,
+                 "vocab": vocab, "seed": 13},
+            ]
+        self._sources = []
+        weights = []
+        for s in specs:
+            w = float(s.get("weight", 1.0))
+            if w <= 0:
+                raise ValueError(f"source {s.get('name')!r}: weight {w} <= 0")
+            if "path" in s:
+                src = _FileTokenSource(s["name"], s["path"], self.seq_len)
+            else:
+                src = _SyntheticTokenSource(
+                    s["name"], int(s.get("tokens", 65536)),
+                    int(s.get("vocab", config.get("vocab", 256))),
+                    int(s.get("seed", 0)), self.seq_len)
+            self._sources.append(src)
+            weights.append(w)
+        names = [s.name for s in self._sources]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate source names: {names}")
+        self._names = names
+        tot = sum(weights)
+        self._weights = [w / tot for w in weights]
+        hints = [s.vocab_hint for s in self._sources if s.vocab_hint]
+        self.vocab = int(config.get("vocab", max(hints) if hints else 256))
+        self.n_classes = self.vocab
+        self.sample_shape = (self.seq_len,)
+        # nominal epoch length: streams have none, the trainer's epoch loop
+        # needs one (n_train sequences per nominal epoch)
+        self.n_train = int(config.get("n_train", 512))
+        self.n_val = int(config.get("n_val", 128))
+        # stream position: start-of-epoch cursor base, per source.  Live
+        # iteration works on a COPY; the base advances only when an epoch
+        # generator is exhausted (or via set_state), so a checkpoint taken
+        # mid-epoch pairs the base with the trainer's consumed-batch cursor
+        # regardless of how far a prefetcher ran ahead.
+        self._base_cursors = {n: 0 for n in names}
+        self._base_epoch = 0
+        self._warmed = False
+
+    # -- checkpointable state (ISSUE 10 contract) ----------------------------
+    def state(self) -> dict:
+        return {
+            "version": 1,
+            "weights": {n: w for n, w in zip(self._names, self._weights)},
+            "cursors": dict(self._base_cursors),
+            "base_epoch": int(self._base_epoch),
+        }
+
+    def set_state(self, state: dict) -> None:
+        if not state:
+            return
+        weights = state.get("weights")
+        if weights:
+            missing = [n for n in self._names if n not in weights]
+            if missing:
+                raise ValueError(
+                    f"stream state missing sources {missing} "
+                    f"(have {sorted(weights)})")
+            ws = [float(weights[n]) for n in self._names]
+            tot = sum(ws)
+            self._weights = [w / tot for w in ws]
+        for n, c in (state.get("cursors") or {}).items():
+            if n in self._base_cursors:
+                self._base_cursors[n] = int(c)
+        self._base_epoch = int(state.get("base_epoch", 0))
+
+    def set_mixture_weights(self, weights: dict) -> None:
+        """Runtime mixture re-weighting (curriculum).  Takes effect at the
+        next ``train_batches`` call (epoch granularity — the weights in
+        effect for an epoch are snapshotted at generator creation, so a
+        resumed replay of that epoch uses the checkpointed weights, not
+        whatever was installed later)."""
+        ws = [float(weights[n]) for n in self._names]
+        if any(w <= 0 for w in ws):
+            raise ValueError(f"weights must be positive: {weights}")
+        tot = sum(ws)
+        self._weights = [w / tot for w in ws]
+
+    # -- iteration -----------------------------------------------------------
+    def _choices(self, batch_size, epoch, seed, batch, weights):
+        """Batch ``batch``'s source index per element.
+
+        Keyed per GLOBAL SAMPLE index (``batch * batch_size + j``), not per
+        batch: an elastic resume re-batches the same sample stream at a
+        different global batch size, and only sample-keyed choices keep the
+        flat sample order identical across that re-batching (the
+        device-count-independence the sample cursor promises).  The uniform
+        draw is ``derive_seed`` itself mapped into [0, 1) — one hash per
+        sample, no RandomState construction."""
+        cdf = np.cumsum(weights)
+        out = np.empty(batch_size, np.int64)
+        base = int(batch) * int(batch_size)
+        for j in range(batch_size):
+            u = derive_seed("mix", seed, epoch, base + j) / float(2**31)
+            out[j] = min(int(np.searchsorted(cdf, u, side="right")),
+                         len(self._sources) - 1)
+        return out
+
+    def _warm(self):
+        """Parallel warm-load of file-source shards through the shm pool
+        token mode (spawn cost paid once; epoch iteration then hits the
+        in-memory caches)."""
+        self._warmed = True
+        file_srcs = [s for s in self._sources
+                     if isinstance(s, _FileTokenSource)]
+        if self.loader_workers <= 0 or not file_srcs:
+            return
+        from theanompi_tpu.models.data.shm_loader import ShmShardPool
+
+        jobs = [(src, j) for src in file_srcs
+                for j in range(len(src.shard_paths))]
+        nbytes = max(4 * int(read_with_retry(
+            lambda p=p: np.load(p, mmap_mode="r").shape[0], what=p))
+            for src in file_srcs for p in src.shard_paths)
+        pool = ShmShardPool(1, 1, self.loader_workers, slot_nbytes=nbytes)
+        try:
+            tasks = [(("tokens", src.shard_paths[j]), 0) for src, j in jobs]
+            for (src, j), (toks, _y) in zip(jobs, pool.run(tasks)):
+                src.cache_shard(j, toks)
+        finally:
+            pool.close()
+
+    def train_batches(self, batch_size, epoch, seed=0, start_batch=0):
+        if not self._warmed:
+            self._warm()
+        weights = list(self._weights)  # snapshot: one epoch, one mixture
+        cursors = dict(self._base_cursors)
+        names = self._names
+        # fast-forward by cursor arithmetic: replay only the integer
+        # mixture choices of the consumed batches — no token reads
+        for i in range(int(start_batch)):
+            for s in self._choices(batch_size, epoch, seed, i, weights):
+                cursors[names[s]] += 1
+        n_batches = self.n_train // batch_size
+        for i in range(int(start_batch), n_batches):
+            choice = self._choices(batch_size, epoch, seed, i, weights)
+            xs = np.empty((batch_size, self.seq_len + 1), np.int32)
+            for j, s in enumerate(choice):
+                src = self._sources[int(s)]
+                xs[j] = src.window(cursors[src.name])
+                cursors[src.name] += 1
+            yield {"x": xs[:, :-1], "y": xs[:, 1:]}
+        # nominal epoch complete: the stream does not rewind — the next
+        # epoch continues from here
+        self._base_cursors = cursors
+        self._base_epoch = int(epoch) + 1
+
+    def val_batches(self, batch_size):
+        """Deterministic held-aside windows: round-robin over sources at
+        derived window indices — no cursor motion, identical every call."""
+        if not self._warmed:
+            self._warm()
+        n_srcs = len(self._sources)
+        for i in range(self.n_val // batch_size):
+            xs = np.empty((batch_size, self.seq_len + 1), np.int32)
+            for j in range(batch_size):
+                k = i * batch_size + j
+                src = self._sources[k % n_srcs]
+                # offset past the low windows train consumes first
+                w = (src.n_windows // 2 + derive_seed("val", k)) \
+                    % src.n_windows
+                xs[j] = src.window(w)
+            yield {"x": xs[:, :-1], "y": xs[:, 1:]}

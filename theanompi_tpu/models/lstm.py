@@ -20,7 +20,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from theanompi_tpu.models.contract import SupervisedModel
-from theanompi_tpu.models.data.base import Dataset, SyntheticSequenceDataset
+from theanompi_tpu.models.data.base import (
+    Dataset,
+    SyntheticSequenceDataset,
+    derive_seed,
+)
 from theanompi_tpu.ops import initializers as init_lib
 from theanompi_tpu.ops import layers as L
 
@@ -69,10 +73,11 @@ class PTBData(Dataset):
         n = len(ids) // t
         return ids[: n * t].reshape(n, t)
 
-    def train_batches(self, batch_size: int, epoch: int, seed: int = 0):
-        rng = np.random.RandomState(hash((seed, epoch)) % (2**31))
+    def train_batches(self, batch_size: int, epoch: int, seed: int = 0,
+                      start_batch: int = 0):
+        rng = np.random.RandomState(derive_seed("shuffle", seed, epoch))
         order = rng.permutation(self.n_train)
-        for i in range(self.n_train // batch_size):
+        for i in range(int(start_batch), self.n_train // batch_size):
             s = self._train_seqs[order[i * batch_size : (i + 1) * batch_size]]
             yield {"x": s[:, :-1], "y": s[:, 1:]}
 

@@ -190,9 +190,29 @@ class TransformerLM(SupervisedModel):
         # PipelineTransformerLM's stacked-layer scan.  1 = the r4 behavior.
         "layers_unroll": 1,
         "loss_unroll": 1,
+        # "stream" (or any stream_sources/stream_dir config) switches the
+        # data plane to the checkpointable multi-source token stream
+        # (models/data/stream.py); default stays the PTB-style chopped set
+        "dataset": "ptb",
     }
 
     def build_data(self):
+        cfg = self.config
+        if (cfg.get("dataset") == "stream" or cfg.get("stream_sources")
+                or cfg.get("stream_dir")):
+            from theanompi_tpu.models.data.stream import StreamTokenDataset
+
+            if cfg.get("stream_dir") and not cfg.get("stream_sources"):
+                import os
+
+                root = cfg["stream_dir"]
+                cfg = dict(cfg)
+                cfg["stream_sources"] = [
+                    {"name": d, "path": os.path.join(root, d)}
+                    for d in sorted(os.listdir(root))
+                    if os.path.isdir(os.path.join(root, d))
+                ]
+            return StreamTokenDataset(cfg)
         return PTBData(self.config)
 
     def _make_block(self) -> L.Layer:

@@ -16,6 +16,7 @@ supplies the compiled step + parameter layout:
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 from contextlib import nullcontext
@@ -332,6 +333,7 @@ class BaseTrainer:
                  checkpoint_dir: str | None = None, checkpoint_keep: int = 3,
                  checkpoint_async: bool = True,
                  checkpoint_verify: str = "auto",
+                 checkpoint_every_n_iters: int = 0,
                  resume_force: bool = False,
                  resume_reshard: bool = False,
                  profile_dir: str | None = None,
@@ -362,6 +364,20 @@ class BaseTrainer:
                 f"checkpoint_verify must be auto/fast/full/none, "
                 f"got {checkpoint_verify!r}")
         self.checkpoint_verify = checkpoint_verify
+        # ISSUE 10: mid-epoch save cadence in iterations (0 = boundary-only,
+        # the old behavior).  Cadence saves stamp the data-plane cursor into
+        # the manifest, so a SIGKILL between boundaries resumes at the
+        # newest iteration — replaying no batch and skipping none
+        self.checkpoint_every_n_iters = int(checkpoint_every_n_iters or 0)
+        if self.checkpoint_every_n_iters < 0:
+            raise ValueError(
+                f"checkpoint_every_n_iters must be >= 0, "
+                f"got {checkpoint_every_n_iters}")
+        self._resume_data_state: dict | None = None
+        # batch-trace witness (ISSUE 10 tests/debug): when set, one line
+        # per consumed global batch — "epoch batch_index" — is appended,
+        # so no-replay/no-skip across a crash is a file diff
+        self._data_trace_path = os.environ.get("THEANOMPI_DATA_TRACE")
         if checkpoint_dir:
             from theanompi_tpu.utils.checkpoint import Checkpointer
 
@@ -400,6 +416,14 @@ class BaseTrainer:
         # below guards on it, so a disabled run makes zero telemetry calls
         self.telemetry = telemetry
         self.recorder.telemetry = telemetry
+        # ISSUE 10: the data layer's read-retry telemetry and fault hooks
+        # are module-level (datasets outlive trainers and run on loader
+        # threads/processes); wire only when there is something to wire,
+        # so a bare trainer never clobbers hooks a test installed
+        if telemetry is not None or self.fault_plan is not None:
+            from theanompi_tpu.models.data.base import set_data_hooks
+
+            set_data_hooks(telemetry=telemetry, fault_plan=self.fault_plan)
         self._compiled_step_cache: tuple | None = None  # (shape key, exe)
         self._exchange_wire_bytes_cached: int | None = None
         # per-step host->device scalar hoisting (ISSUE 2 satellite): the
@@ -503,6 +527,7 @@ class BaseTrainer:
         reset is just counters + recorder)."""
         self.iteration = 0
         self.epoch = 0
+        self._resume_data_state = None
         self.recorder = Recorder(
             print_freq=self.recorder.print_freq,
             save_dir=self.recorder.save_dir,
@@ -554,8 +579,35 @@ class BaseTrainer:
             **model_fingerprint(self.model),
         }
 
-    def save_checkpoint(self, epoch: int):
+    def _data_state(self, epoch: int, completed: bool) -> dict:
+        """The data-plane position a checkpoint captures (ISSUE 10).
+
+        The cursor is stored in SAMPLES, not this run's batches: an
+        elastic resume divides by ITS OWN global batch, so a mesh8->4
+        restart consumes the exact same global sample sequence the mesh8
+        run would have.  ``dataset`` is :meth:`Dataset.state` — cursors
+        that persist ACROSS epochs (stream mixture cursors), restored on
+        boundary resumes too, not just mid-epoch ones.
+        """
+        cursor = max(0, self.iteration - self._epoch_start_iter)
+        return {
+            "version": 1,
+            "epoch": int(epoch),
+            "completed": bool(completed),
+            "batch_cursor": int(cursor),
+            "sample_cursor": int(cursor) * int(self.global_batch),
+            "global_batch": int(self.global_batch),
+            "seed": int(self.seed),
+            "dataset": self.model.data.state(),
+        }
+
+    def save_checkpoint(self, epoch: int, completed: bool = True):
         """Kick off a checkpoint save; -> SaveHandle (or None, no dir).
+
+        ``completed=False`` (ISSUE 10) marks a MID-epoch save (iteration
+        cadence, preemption): the manifest's ``data_state`` carries the
+        consumed-batch cursor and ``try_resume`` re-enters the epoch there
+        instead of treating it as finished.
 
         The training thread pays only the blocking snapshot (multi-host
         gathers + overlapped device→host copies + a cheap recorder-history
@@ -569,7 +621,8 @@ class BaseTrainer:
         return self.checkpointer.save(
             epoch, self.iteration, self.checkpoint_trees(),
             recorder_snapshot=self.recorder.history_snapshot(),
-            lr_scale=self.lr_scale)
+            lr_scale=self.lr_scale,
+            data_state=self._data_state(epoch, completed))
 
     def _resume_verify_level(self) -> str:
         """ISSUE 5 verify policy: the cheap structural check always; the
@@ -605,8 +658,20 @@ class BaseTrainer:
         epoch, iteration, restored = res
         for name, tree in restored.items():
             setattr(self, name, tree)  # params/state/opt_state + rule extras
-        self.epoch = epoch + 1  # that epoch completed
+        ds = (self.checkpointer.last_loaded_manifest or {}).get("data_state")
+        if ds and not ds.get("completed", True):
+            # mid-epoch checkpoint (ISSUE 10): re-enter the saved epoch at
+            # the saved cursor — _run_epochs fast-forwards the data plane
+            # by cursor arithmetic, replaying nothing and skipping nothing
+            self.epoch = int(ds.get("epoch", epoch))
+            self._resume_data_state = dict(ds)
+        else:
+            self.epoch = epoch + 1  # that epoch completed
         self.iteration = iteration
+        if ds and isinstance(ds.get("dataset"), dict) and ds["dataset"]:
+            # dataset-internal cursors (stream mixture positions) persist
+            # ACROSS epochs: restore them on boundary resumes too
+            self.model.data.set_state(ds["dataset"])
         plan = self.checkpointer.last_reshard_plan
         if plan is not None:
             # ISSUE 8: the load replanned a topology change — apply the
@@ -628,7 +693,11 @@ class BaseTrainer:
                 self.lr_scale = float(man.get("lr_scale", 1.0) or 1.0)
         self.recorder.load(self.checkpointer.directory)
         if self.recorder.verbose:
-            print(f"resumed from epoch {epoch} "
+            where = (f"mid-epoch {self.epoch} at batch "
+                     f"{self._resume_data_state.get('batch_cursor', 0)}"
+                     if self._resume_data_state is not None
+                     else f"epoch {epoch}")
+            print(f"resumed from {where} "
                   f"(iteration {self.iteration})", flush=True)
         return True
 
@@ -933,13 +1002,20 @@ class BaseTrainer:
         return means
 
     # -- full run (reference *_worker.run) -----------------------------------
-    def _make_prefetcher(self, epoch: int):
-        """The para_load equivalent: read/augment/transfer overlaps compute."""
+    def _make_prefetcher(self, epoch: int, start_batch: int = 0):
+        """The para_load equivalent: read/augment/transfer overlaps compute.
+
+        ``start_batch`` (ISSUE 10): the resume cursor — the dataset
+        fast-forwards to it by seed/cursor arithmetic (no batch is
+        materialized to be thrown away) and the prefetcher's fault and
+        consumption ordinals stay GLOBAL batch indices across the restart.
+        """
         from theanompi_tpu.models.data.prefetch import prefetch
 
         return prefetch(
             self.model.data.train_batches(self.global_batch, epoch,
-                                          seed=self.seed),
+                                          seed=self.seed,
+                                          start_batch=start_batch),
             mesh=self.mesh,
             depth=self.prefetch_depth,
             spec=self.batch_spec,
@@ -950,6 +1026,7 @@ class BaseTrainer:
             # lives inside the worker
             stall_timeout=self.resilience.prefetch_stall_timeout,
             fault_plan=self.fault_plan,
+            start_batch=start_batch,
         )
 
     def _check_preempt(self) -> None:
@@ -960,27 +1037,26 @@ class BaseTrainer:
     def _preemption_checkpoint(self) -> bool:
         """The final synchronous checkpoint of a preempted run.
 
-        The state is labeled with the last *completed* epoch and that
-        epoch's boundary iteration, so the resume machinery is untouched:
-        a resumed run replays the interrupted epoch from its start with
-        the mid-epoch params (steps already taken train again — at-least-
-        once epoch semantics, never a lost or inconsistent state).  When
-        no step has run since the last boundary save there is nothing new
-        to capture; the in-flight async writer (if any) is joined so the
-        boundary checkpoint is durably published before exiting.
+        ISSUE 10: the state is labeled with the CURRENT epoch and carries
+        the data-plane cursor (``completed=False``), so the resumed run
+        re-enters the interrupted epoch at the first unconsumed batch —
+        exactly-once data consumption, replacing the old at-least-once
+        epoch replay (which re-trained every step since the boundary).
+        When no step has run since the last boundary save there is
+        nothing new to capture; the in-flight async writer (if any) is
+        joined so the boundary checkpoint is durably published before
+        exiting.
         """
         if self.checkpointer is None:
             return False
         if self.iteration <= self._epoch_start_iter:
             self.checkpointer.join_pending()
             return False
-        label = self.epoch - 1  # the current epoch is in progress
-        if label < 0:
-            return False  # mid-first-epoch: resume simply starts fresh
         handle = self.checkpointer.save(
-            label, self._epoch_start_iter, self.checkpoint_trees(),
+            self.epoch, self.iteration, self.checkpoint_trees(),
             recorder_snapshot=self.recorder.history_snapshot(),
-            lr_scale=self.lr_scale)
+            lr_scale=self.lr_scale,
+            data_state=self._data_state(self.epoch, completed=False))
         handle.join()  # synchronous: the process is about to exit
         self.checkpointer.join_pending()
         return True
@@ -1032,14 +1108,31 @@ class BaseTrainer:
         try:
             for epoch in range(self.epoch, model.n_epochs):
                 self.epoch = epoch
-                self._epoch_start_iter = self.iteration
+                start_batch = 0
+                rds, self._resume_data_state = self._resume_data_state, None
+                if rds is not None and int(rds.get("epoch", -1)) == epoch:
+                    # ISSUE 10: resume INSIDE this epoch.  The cursor is
+                    # in samples (device-count-independent): an elastic
+                    # resume divides by its OWN global batch, preserving
+                    # the exact global sample order across a mesh change
+                    sc = int(rds.get("sample_cursor", 0))
+                    start_batch = sc // self.global_batch
+                    if sc % self.global_batch:
+                        print(f"trainer: resume sample cursor {sc} is not "
+                              f"divisible by the global batch "
+                              f"{self.global_batch}; flooring to batch "
+                              f"{start_batch} (the partial batch replays)",
+                              file=sys.stderr, flush=True)
+                    self._epoch_start_iter = self.iteration - start_batch
+                else:
+                    self._epoch_start_iter = self.iteration
                 self._check_preempt()
                 self.recorder.start_epoch()
                 # lr_scale is 1.0 except after an elastic reshard (x1.0 is
                 # float-exact, so unresharded lineages are bit-unchanged)
                 lr = model.adjust_hyperp(epoch) * self.lr_scale
                 if batches is None:  # not pre-built at the last boundary
-                    batches = self._make_prefetcher(epoch)
+                    batches = self._make_prefetcher(epoch, start_batch)
                 it = iter(batches)
                 try:
                     while True:
@@ -1057,6 +1150,28 @@ class BaseTrainer:
                             break
                         self.recorder.end("wait")
                         self.train_iter(batch, lr)
+                        if (self._data_trace_path
+                                and jax.process_index() == 0):
+                            # consumed-batch witness: (epoch, global batch
+                            # index) of the step that just COMPLETED — a
+                            # step killed inside train_iter leaves no line,
+                            # so a resumed lineage's trace concatenates to
+                            # exactly the uninterrupted sequence (the
+                            # no-replay/no-skip assert in the e2e tests)
+                            with open(self._data_trace_path, "a") as tf:
+                                tf.write(
+                                    f"{epoch} "
+                                    f"{self.iteration - 1 - self._epoch_start_iter}"
+                                    f"\n")
+                        cad = self.checkpoint_every_n_iters
+                        if (cad and self.checkpointer is not None
+                                and (self.iteration
+                                     - self._epoch_start_iter) % cad == 0):
+                            # iteration-cadence mid-epoch save (ISSUE 10):
+                            # stamps the data cursor; superseded by later
+                            # cadence saves and the boundary save (same
+                            # epoch label, atomic overwrite)
+                            self.save_checkpoint(epoch, completed=False)
                         self._check_preempt()
                 finally:
                     # a step failure must not leave the loader thread pinning
@@ -1248,6 +1363,11 @@ class Rule:
             # ISSUE 5: verify mode (auto = fast, full after unclean exit)
             # and the fingerprint-mismatch override (--resume-force)
             checkpoint_verify=self.config.get("checkpoint_verify", "auto"),
+            # ISSUE 10: mid-epoch save cadence in iterations (0 = off);
+            # each cadence save stamps the data-plane cursor so a crash
+            # resumes at the newest iteration, not the epoch start
+            checkpoint_every_n_iters=int(
+                self.config.get("checkpoint_every_n_iters", 0) or 0),
             resume_force=bool(self.config.get("resume_force", False)),
             # ISSUE 8: open the elastic reshard gate (--resume-reshard)
             resume_reshard=bool(self.config.get("resume_reshard", False)),

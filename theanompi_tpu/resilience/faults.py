@@ -20,6 +20,12 @@ specs separated by ``;`` or ``,``)::
     prefetch:stall@3     the Prefetcher's source hangs before batch 3
                          (exercises stall_timeout / PrefetchStallError)
     prefetch:raise@3     the source iterator raises at batch 3
+    data:torn_read@2     read_with_retry's 3rd read (ordinal 2) raises a
+                         short-read ValueError once — the retry loop and
+                         the DATA_RETRY telemetry counter are exercised
+    data:stall@2         the 3rd data read hangs (a dead NFS mount) until
+                         released (models.data.base.release_data_stalls)
+                         or the reading thread/process is torn down
     checkpoint:fail@1    Checkpointer._write raises OSError for epoch 1
     checkpoint:truncate@1       ISSUE 5 corruption sites (ckpt_truncate /
     checkpoint:bitflip@1        ckpt_bitflip / ckpt_manifest_drop): damage
@@ -34,8 +40,10 @@ specs separated by ``;`` or ``,``)::
                          no restart loop over an unplannable transition)
 
 ``INDEX`` is the global step for ``step``, the batch ordinal for
-``prefetch``, the epoch for ``checkpoint``, and the supervisor attempt
-for ``reshard``.  The optional ``ATTEMPT``
+``prefetch``, the per-process read ordinal for ``data`` (every
+``read_with_retry`` call draws the next ordinal; ``set_data_hooks``
+resets the counter), the epoch for ``checkpoint``, and the supervisor
+attempt for ``reshard``.  The optional ``ATTEMPT``
 gates a spec to one supervisor attempt (``THEANOMPI_ATTEMPT``, which the
 supervisor sets; unsupervised processes count as attempt 1) — a ``kill``
 spec under supervision should carry ``@1`` so the restarted attempt does
@@ -65,6 +73,7 @@ class FaultPlanError(ValueError):
 SITES = {
     "step": ("raise", "kill", "nan"),
     "prefetch": ("stall", "raise"),
+    "data": ("torn_read", "stall"),
     "checkpoint": ("fail", "truncate", "bitflip", "manifest_drop"),
     "reshard": ("fail",),
 }

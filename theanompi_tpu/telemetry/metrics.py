@@ -79,6 +79,14 @@ SERVE_COUNTERS = ("serve.tokens", "serve.preemptions", "serve.requests")
 # ``resilience.json`` audit log via ``resilience/events.py``.
 RESHARD_INSTANTS = ("reshard.plan", "reshard.apply")
 
+# -- data-plane counter names (ISSUE 10) -------------------------------------
+# ``data.retries``: one count per retried shard/token-file read inside
+# ``models.data.base.read_with_retry`` (tags: what — the caller's label for
+# the resource).  A rising rate is the early witness of a flaky data mount
+# long before DataReadError escalates; emitted through this registered name
+# ONLY (same one-source-of-truth contract as the serving/reshard names).
+DATA_COUNTERS = ("data.retries",)
+
 
 class MetricsRegistry:
     """Named counters (monotonic totals), gauges (last value), histograms

@@ -111,6 +111,12 @@ import numpy as np
 #: manifest schema version (bump on incompatible change)
 MANIFEST_VERSION = 1
 
+#: payload-leaf key for the data-plane state (ISSUE 10): json bytes as a
+#: uint8 array, so it rides inside the .npz under the same CRC/member-set
+#: integrity machinery as the model leaves.  Deliberately carries no "::"
+#: so template restore (which filters on "{tree}::") never sees it.
+DATA_STATE_LEAF = "__data_state__"
+
 
 class CheckpointError(RuntimeError):
     """Base class for typed checkpoint failures."""
@@ -208,7 +214,8 @@ def _leaf_crc(arr: np.ndarray) -> int:
 def build_manifest(epoch: int, iteration: int,
                    flat: dict[str, np.ndarray],
                    fingerprint: dict | None,
-                   lr_scale: float = 1.0) -> dict:
+                   lr_scale: float = 1.0,
+                   data_state: dict | None = None) -> dict:
     """Deterministic manifest for a flat leaf dict: no timestamps, sorted
     keys at serialization time — async and sync saves of the same state
     must produce byte-identical manifests (tested).
@@ -219,23 +226,34 @@ def build_manifest(epoch: int, iteration: int,
     a reshard back to the original count — composes factors instead of
     re-deriving from the wrong baseline: mesh8 -> mesh4 -> mesh8 nets
     exactly 1.0 again.
+
+    ``data_state`` (ISSUE 10): the data plane's consumption position —
+    epoch, consumed-sample cursor, shuffle seed, dataset-specific cursors
+    (``Dataset.state()``).  The cursor is stored in SAMPLES, not batches,
+    so it is device-count-independent: an elastic mesh8->4 resume divides
+    by its own global batch and keeps the exact global sample order.
+    Omitted (not ``None``-valued) when absent, so pre-ISSUE-10 manifests
+    and data-stateless saves stay byte-identical to before.
     """
-    return {
+    out = {
         "format": MANIFEST_VERSION,
         "epoch": int(epoch),
         "iteration": int(iteration),
         "lr_scale": float(lr_scale),
         "fingerprint": fingerprint,
-        "leaves": {
-            k: {
-                "shape": list(a.shape),
-                "dtype": str(a.dtype),
-                "nbytes": int(a.nbytes),
-                "crc32": _leaf_crc(a),
-            }
-            for k, a in flat.items()
-        },
     }
+    if data_state is not None:
+        out["data_state"] = data_state
+    out["leaves"] = {
+        k: {
+            "shape": list(a.shape),
+            "dtype": str(a.dtype),
+            "nbytes": int(a.nbytes),
+            "crc32": _leaf_crc(a),
+        }
+        for k, a in flat.items()
+    }
+    return out
 
 
 def _check_leaf(name: str, key: str, meta: dict, arr: np.ndarray) -> None:
@@ -616,7 +634,11 @@ def plan_reshard(manifest: dict, target_fp: dict,
     if old_n < 1 or new_n < 1:
         raise CheckpointReshardError(
             f"nonsensical data-axis sizes (checkpoint {old_n}, run {new_n})")
-    tree_names = {k.split("::", 1)[0] for k in manifest.get("leaves", {})}
+    # the __data_state__ payload leaf is device-count-INDEPENDENT by
+    # construction (sample cursor, not batch cursor) — never a reshard
+    # obstacle, so it is exempt from the rule-extras refusal below
+    tree_names = {k.split("::", 1)[0] for k in manifest.get("leaves", {})
+                  if k != DATA_STATE_LEAF}
     extras = sorted(tree_names - {"params", "state", "opt_state"})
     if extras:
         raise CheckpointReshardError(
@@ -874,10 +896,15 @@ class Checkpointer:
 
     def save(self, epoch: int, iteration: int, trees: dict,
              recorder_snapshot: dict | None = None,
-             lr_scale: float = 1.0) -> SaveHandle:
+             lr_scale: float = 1.0,
+             data_state: dict | None = None) -> SaveHandle:
         """``trees``: name -> pytree (params/state/opt_state/extras).
         ``lr_scale``: the lineage's cumulative linear-scaling LR factor
         (see :func:`build_manifest`; the trainer threads its own through).
+        ``data_state`` (ISSUE 10): JSON-serializable data-plane position;
+        stamped into the manifest AND stored as a ``__data_state__``
+        payload leaf (json bytes as uint8), so the per-leaf CRC and the
+        member-set check cover it like any model leaf.
 
         On a multi-host pod every process must call this (the host-gather of
         cross-host-sharded leaves is a collective); only process 0 writes.
@@ -894,19 +921,23 @@ class Checkpointer:
         with (tel.span("checkpoint.snapshot", epoch=epoch)
               if tel is not None else nullcontext()):
             flat = self._snapshot(trees)
+        if data_state is not None:
+            flat[DATA_STATE_LEAF] = np.frombuffer(
+                json.dumps(data_state, sort_keys=True).encode("utf-8"),
+                dtype=np.uint8).copy()
         handle = SaveHandle(self._path(epoch), epoch)
         if jax.process_index() != 0:
             return handle
         self._mark_dirty()
         if not self.async_save:
             self._write(handle, epoch, iteration, flat, recorder_snapshot,
-                        lr_scale)
+                        lr_scale, data_state)
             return handle
 
         def work():
             try:
                 self._write(handle, epoch, iteration, flat,
-                            recorder_snapshot, lr_scale)
+                            recorder_snapshot, lr_scale, data_state)
             except BaseException as e:
                 handle._error = e
 
@@ -919,7 +950,8 @@ class Checkpointer:
     def _write(self, handle: SaveHandle, epoch: int, iteration: int,
                flat: dict[str, np.ndarray],
                recorder_snapshot: dict | None,
-               lr_scale: float = 1.0) -> None:
+               lr_scale: float = 1.0,
+               data_state: dict | None = None) -> None:
         """Serialize + atomically publish + prune + scrub (writer thread in
         async mode, inline in sync mode — one code path, so the published
         bytes, manifest included, are identical either way)."""
@@ -933,7 +965,7 @@ class Checkpointer:
         np.savez(tmp, **flat)
         manifest = build_manifest(epoch, iteration, flat,
                                   self._resolved_fingerprint(),
-                                  lr_scale=lr_scale)
+                                  lr_scale=lr_scale, data_state=data_state)
         mpath = _manifest_path(handle.path)
         with open(mpath + ".tmp", "w") as f:
             json.dump(manifest, f, sort_keys=True, indent=1)
