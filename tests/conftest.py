@@ -49,3 +49,80 @@ def mesh4x2():
     from theanompi_tpu.parallel.mesh import make_mesh
 
     return make_mesh(n_data=4, n_model=2)
+
+
+# -- trained-model session fixtures (ISSUE 11 satellite) ----------------------
+# Several files used to train their own tiny model per module; at session
+# scope the training cost is paid once for the whole tier-1 run.
+
+#: the serving test config (test_serving imports this as its TINY — one
+#: source of truth, so the fixture and the per-test references can't drift)
+SERVING_TINY = {
+    "batch_size": 2, "n_train": 64, "n_val": 32, "seq_len": 32,
+    "vocab": 61, "dim": 32, "heads": 2, "n_layers": 2,
+    "dropout": 0.0, "n_epochs": 1, "precision": "fp32",
+}
+
+
+@pytest.fixture(scope="session")
+def dense_model():
+    """A tiny TransformerLM lightly trained on the synthetic bigram stream
+    (40 plain-SGD steps, one jit) — serving tests run against weights with
+    real structure: at random init the logits are near-tied and int8
+    argmax agreement measures coin flips, not quantization quality.
+    Session-scoped and treated as READ-ONLY by every consumer."""
+    from theanompi_tpu.models.transformer_lm import TransformerLM
+
+    model = TransformerLM(dict(SERVING_TINY))
+    params, state = model.init_params(jax.random.PRNGKey(0))
+    batches = list(model.data.train_batches(8, 0, seed=0))
+
+    @jax.jit
+    def step(p, batch):
+        g = jax.grad(
+            lambda p: model.loss_fn(p, state, batch, None, False)[0])(p)
+        return jax.tree.map(lambda a, b: a - 0.5 * b, p, g)
+
+    for i in range(40):
+        params = step(params, batches[i % len(batches)])
+    return model, params, state
+
+
+#: the checkpoint-integrity trainer config (test_checkpoint_integrity
+#: imports this as its TINY — same one-source-of-truth contract)
+WRN_TINY = {"depth": 10, "widen": 1, "batch_size": 8, "image_size": 8,
+            "n_train": 32, "n_val": 16, "n_epochs": 2, "precision": "fp32",
+            "augment": False, "verbose": False, "lr": 0.05}
+
+
+def make_wrn_trainer(mesh, checkpoint_dir, n_epochs=2, **kw):
+    """A compiled, initialized tiny-WRN BSP trainer over ``mesh`` — the
+    shared builder behind :func:`trained_wrn_ckpt` and the checkpoint
+    tests' resuming trainers (identical construction => identical resume
+    fingerprint)."""
+    from theanompi_tpu.models.wide_resnet import WideResNet
+    from theanompi_tpu.parallel.bsp import BSPTrainer
+    from theanompi_tpu.utils.recorder import Recorder
+
+    t = BSPTrainer(
+        WideResNet({**WRN_TINY, "n_epochs": n_epochs}), mesh=mesh,
+        exch_strategy="psum",
+        recorder=Recorder(verbose=False, print_freq=4),
+        checkpoint_dir=checkpoint_dir, **kw,
+    )
+    t.compile_iter_fns()
+    t.init_state()
+    return t
+
+
+@pytest.fixture(scope="session")
+def trained_wrn_ckpt(tmp_path_factory, mesh4):
+    """A completed 2-epoch tiny-WRN training run's checkpoint directory
+    (epochs 0 and 1 published, clean-shutdown handshake done).  Tests
+    that corrupt or resume MUST ``shutil.copytree`` it into their own
+    tmp_path first — the session copy is read-only."""
+    d = str(tmp_path_factory.mktemp("wrn-trained") / "ck")
+    t = make_wrn_trainer(mesh4, d)
+    t.run()
+    assert not t.checkpointer.was_unclean()
+    return d

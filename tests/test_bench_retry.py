@@ -105,19 +105,28 @@ def test_backend_transient_init_failure_keeps_retry_path():
     assert bench.backend_hint(ValueError("bad BENCH_BS")) is None
 
 
-def test_backend_unavailable_fails_fast_end_to_end():
+def test_backend_unavailable_fails_fast_end_to_end(tmp_path):
     """The subprocess contract: an absent backend exits once with the
-    one-line error — no 5 x 60 s retry burn, no raw jax traceback."""
+    one-line error — no 5 x 60 s retry burn, no raw jax traceback — and
+    leaves a TYPED stub artifact (ISSUE 11 satellite: a fleet scraping
+    bench outputs can tell "backend absent" from "bench never ran")."""
+    stub_path = str(tmp_path / "BENCH_unavailable.json")
     p = subprocess.run(
         [sys.executable, BENCH], capture_output=True, text=True, timeout=120,
-        env=_env(BENCH_PLATFORM="nope", BENCH_INIT_RETRIES=5),
+        env=_env(BENCH_PLATFORM="nope", BENCH_INIT_RETRIES=5,
+                 BENCH_UNAVAILABLE_OUT=stub_path),
     )
     assert p.returncode != 0
     assert "backend 'nope' unavailable" in p.stderr
     assert "JAX_PLATFORMS" in p.stderr
     assert "Traceback" not in p.stderr
     assert "attempt 1/" not in p.stderr  # no retries were burned
-    assert p.stdout.strip() == ""
+    assert p.stdout.strip() == ""  # the JSON-line contract: no artifact
+    stub = json.load(open(stub_path))  # ... on stdout; the stub is a FILE
+    assert stub["status"] == "backend_unavailable"
+    assert "'nope'" in stub["error"] and "\n" not in stub["error"]
+    assert "run_id" in stub
+    assert not os.path.exists(stub_path + ".tmp")  # atomic publish
 
 
 class _FakeRecorder:

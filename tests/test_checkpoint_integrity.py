@@ -15,6 +15,7 @@ checkpoint -> run completes with the correct final epoch count.
 
 import json
 import os
+import shutil
 import signal
 import subprocess
 import sys
@@ -36,9 +37,11 @@ from theanompi_tpu.utils.checkpoint import (
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-TINY = {"depth": 10, "widen": 1, "batch_size": 8, "image_size": 8,
-        "n_train": 32, "n_val": 16, "n_epochs": 2, "precision": "fp32",
-        "augment": False, "verbose": False, "lr": 0.05}
+# the trainer config + builder live in conftest.py (ISSUE 11 satellite):
+# the session-scoped ``trained_wrn_ckpt`` fixture trains the 2-epoch run
+# ONCE, and resuming trainers here are built by the same helper so their
+# resume fingerprints match the fixture's checkpoints exactly
+from conftest import WRN_TINY as TINY, make_wrn_trainer  # noqa: E402
 
 #: subprocess flavor of TINY (shapes match tests/test_resilience_e2e.py so
 #: the session-scoped compile cache is shared across both files' children)
@@ -356,36 +359,24 @@ def test_scrubber_cli_report_and_quarantine(tmp_path, capsys):
 
 # -- trainer-level matrix -----------------------------------------------------
 
-def _tiny_trainer(mesh4, checkpoint_dir, n_epochs=2, **kw):
-    from theanompi_tpu.models.wide_resnet import WideResNet
-    from theanompi_tpu.parallel.bsp import BSPTrainer
-    from theanompi_tpu.utils.recorder import Recorder
-
-    t = BSPTrainer(
-        WideResNet({**TINY, "n_epochs": n_epochs}), mesh=mesh4,
-        exch_strategy="psum",
-        recorder=Recorder(verbose=False, print_freq=4),
-        checkpoint_dir=checkpoint_dir, **kw,
-    )
-    t.compile_iter_fns()
-    t.init_state()
-    return t
+_tiny_trainer = make_wrn_trainer
 
 
-def test_cold_resume_falls_back_on_corrupt_latest(tmp_path, mesh4):
+def test_cold_resume_falls_back_on_corrupt_latest(tmp_path, mesh4,
+                                                  trained_wrn_ckpt):
     """A cold try_resume whose latest checkpoint is bit-flipped lands on
     the previous epoch with its exact params (the zip-CRC read error is
     classified as corruption even under the fast verify a clean-exit
     directory gets)."""
+    # corrupt a COPY of the session run (epochs 0+1 published, clean)
     ck = str(tmp_path / "ck")
-    trainer = _tiny_trainer(mesh4, ck)
-    trainer.run()  # publishes epochs 0 and 1, then marks clean
-    assert not trainer.checkpointer.was_unclean()
-    params_e0 = trainer.checkpointer.load(
-        0, {"params": trainer.params}, verify="full")["params"]
-    _bitflip(os.path.join(ck, "ckpt_e0001.npz"))
+    shutil.copytree(trained_wrn_ckpt, ck)
 
     t2 = _tiny_trainer(mesh4, ck)
+    assert not t2.checkpointer.was_unclean()
+    params_e0 = t2.checkpointer.load(
+        0, {"params": t2.params}, verify="full")["params"]
+    _bitflip(os.path.join(ck, "ckpt_e0001.npz"))
     assert t2.try_resume()
     assert t2.epoch == 1  # fell back: epoch 0 completed, 1 is next
     for a, b in zip(jax.tree.leaves(t2.params),
@@ -395,12 +386,13 @@ def test_cold_resume_falls_back_on_corrupt_latest(tmp_path, mesh4):
     assert any(e["name"] == "ckpt.fallback" for e in _events(ck))
 
 
-def test_trainer_fingerprint_mismatch_and_force(tmp_path, mesh4, mesh8):
+def test_trainer_fingerprint_mismatch_and_force(tmp_path, mesh8,
+                                                trained_wrn_ckpt):
     """Resuming under a different mesh is refused with the typed error;
     resume_force turns it into a warned override (params are replicated
     under BSP, so the arrays themselves restore fine)."""
     ck = str(tmp_path / "ck")
-    _tiny_trainer(mesh4, ck).run()
+    shutil.copytree(trained_wrn_ckpt, ck)  # the mesh4 session run
     t8 = _tiny_trainer(mesh8, ck)
     with pytest.raises(CheckpointFingerprintError, match="mesh"):
         t8.try_resume()

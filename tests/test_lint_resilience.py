@@ -68,7 +68,8 @@ def test_swallow_allowlist_still_names_the_documented_sites():
     assert ("theanompi_tpu/launcher.py", "main") in SWALLOW_ALLOWLIST
     assert ("theanompi_tpu/serving/cli.py", "main") in SWALLOW_ALLOWLIST
     assert ("theanompi_tpu/analysis/cli.py", "main") in SWALLOW_ALLOWLIST
-    assert len(SWALLOW_ALLOWLIST) == 5
+    assert ("theanompi_tpu/fleet/cli.py", "main") in SWALLOW_ALLOWLIST
+    assert len(SWALLOW_ALLOWLIST) == 6
 
 
 def test_faultinject_marker_registered():
@@ -95,6 +96,21 @@ def test_serving_never_imports_training_paths():
     assert not offenders, (
         "package layering violated (serving wall / declared DAG):\n"
         + "\n".join(offenders))
+
+
+def test_fleet_wall_names_the_supervised_machinery():
+    """The mirror half of the serving ⊥ fleet wall (ISSUE 11): the fleet
+    supervises the launcher/trainer as SUBPROCESSES and must never import
+    them (even lazily) — the wall list itself is asserted so a layers.py
+    edit can't silently drop an entry.  The clean run rides the
+    import-dag check above."""
+    from theanompi_tpu.analysis.layers import FLEET_FORBIDDEN_IMPORTS
+
+    for mod in ("theanompi_tpu.serving", "theanompi_tpu.parallel",
+                "theanompi_tpu.models", "theanompi_tpu.ops",
+                "theanompi_tpu.launcher"):
+        assert mod in FLEET_FORBIDDEN_IMPORTS
+    assert "theanompi_tpu.fleet" in SERVING_FORBIDDEN_IMPORTS
 
 
 def test_serving_wall_still_catches_the_original_negative_case(tmp_path):

@@ -325,3 +325,61 @@ def test_runbook_tmlint_command(tmp_path, capsys):
     rep = json.loads(open(report).read())
     assert rep["tool"] == "tmlint" and rep["findings"] == []
     assert rep["summary"]["suppressed"] > 0  # markers stay visible
+
+
+def test_runbook_fleet_command(tmp_path, monkeypatch, subproc_compile_cache):
+    """RUNBOOK step 8's fleet rehearsal (ISSUE 11) at toy scale: the exact
+    `tmfleet submit` / `run` / `status` flags BASELINE.md documents must
+    drive two jobs through one mesh8 pool to completion (they fit side by
+    side here — the contention/preemption half of the rehearsal is locked
+    at full depth in test_fleet.py) and leave the artifacts the runbook
+    reads: per-job job.json + resilience.json, fleet_events.jsonl, and
+    the status JSON with every lease returned."""
+    import sys
+
+    from theanompi_tpu.fleet import cli as fleet_cli
+    from theanompi_tpu.fleet import read_fleet_events
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=8")
+    monkeypatch.setenv("JAX_THREEFRY_PARTITIONABLE", "true")
+    monkeypatch.setenv("PYTHONPATH",
+                       repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    monkeypatch.delenv("THEANOMPI_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("THEANOMPI_DATA_TRACE", raising=False)
+    assert sys.executable
+    d = str(tmp_path / "fleet")
+    tiny = ["--set", "depth=10", "--set", "widen=1", "--set", "batch_size=4",
+            "--set", "image_size=8", "--set", "n_train=32",
+            "--set", "n_val=16", "--set", "n_epochs=1",
+            "--set", "precision='fp32'",
+            f"--extra-arg=--compile-cache-dir={subproc_compile_cache}"]
+    for jid, pri in (("nightly", 0), ("ablation", 5)):
+        assert fleet_cli.main([
+            "submit", "--fleet-dir", d, "--job-id", jid,
+            "--priority", str(pri), "--min-devices", "4",
+            "--max-devices", "4", "--max-restarts", "3",
+            "--backoff-base", "0.1", *tiny]) == 0
+    assert fleet_cli.main(["run", "--fleet-dir", d, "--pool-size", "8",
+                           "--quiet"]) == 0
+    # the status JSON the runbook's verdict step reads
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert fleet_cli.main(["status", "--fleet-dir", d]) == 0
+    status = json.loads(buf.getvalue())
+    assert {j["status"] for j in status["jobs"]} == {"done"}
+    assert status["pool"]["pool_size"] == 8 and status["pool"]["leases"] == {}
+    # per-job artifacts: supervisor audit trail + published checkpoint
+    for jid in ("nightly", "ablation"):
+        jdir = os.path.join(d, "jobs", jid)
+        art = json.load(open(os.path.join(jdir, "resilience.json")))
+        assert art["final_exit"] == 0
+        assert "latest.json" in os.listdir(os.path.join(jdir, "ckpt"))
+    names = [e["event"] for e in read_fleet_events(d)]
+    assert names.count("fleet.schedule") == 2
+    assert names.count("fleet.complete") == 2

@@ -39,32 +39,11 @@ from theanompi_tpu.serving import (
 )
 from theanompi_tpu.serving.quant import dequantize_tree, quantize_tree
 
-TINY = {
-    "batch_size": 2, "n_train": 64, "n_val": 32, "seq_len": 32,
-    "vocab": 61, "dim": 32, "heads": 2, "n_layers": 2,
-    "dropout": 0.0, "n_epochs": 1, "precision": "fp32",
-}
-
-
-@pytest.fixture(scope="module")
-def dense_model():
-    """A tiny TransformerLM lightly trained on the synthetic bigram stream
-    (40 plain-SGD steps, one jit) — serving tests run against weights with
-    real structure: at random init the logits are near-tied and int8
-    argmax agreement measures coin flips, not quantization quality."""
-    model = TransformerLM(dict(TINY))
-    params, state = model.init_params(jax.random.PRNGKey(0))
-    batches = list(model.data.train_batches(8, 0, seed=0))
-
-    @jax.jit
-    def step(p, batch):
-        g = jax.grad(
-            lambda p: model.loss_fn(p, state, batch, None, False)[0])(p)
-        return jax.tree.map(lambda a, b: a - 0.5 * b, p, g)
-
-    for i in range(40):
-        params = step(params, batches[i % len(batches)])
-    return model, params, state
+# the lightly-trained ``dense_model`` fixture lives in conftest.py at
+# session scope (ISSUE 11 satellite) — shared with any file that needs
+# trained-LM weights; its config is imported here as TINY so per-test
+# references can't drift from what the fixture trained
+from conftest import SERVING_TINY as TINY  # noqa: E402
 
 
 def _full_argmax_ref(model, params, state, seq):

@@ -80,6 +80,11 @@ LAYER_DAG: tuple[tuple[str, tuple[str, ...], tuple[str, ...]], ...] = (
                    ("codes", "native", "telemetry", "resilience", "mesh",
                     "kernels", "sharding", "ops", "utils_base", "exchange",
                     "data", "models", "ckpt", "training")),
+    # the fleet scheduler runs training JOBS as subprocesses — it must
+    # never import the training (or serving) machinery it supervises;
+    # its world is exit codes, the run_job seam, fault plans, telemetry
+    ("fleet",      (f"{PKG}.fleet",),
+                   ("codes", "telemetry", "resilience", "utils_base")),
     # serving is a read-only consumer: kernels (shared int8 wire format),
     # verified checkpoint loads, telemetry, the launcher's config surface
     # — NEVER exchange/training (see the any-depth wall below)
@@ -90,7 +95,7 @@ LAYER_DAG: tuple[tuple[str, tuple[str, ...], tuple[str, ...]], ...] = (
                    ("codes", "native", "telemetry", "resilience", "mesh",
                     "kernels", "sharding", "ops", "utils_base", "exchange",
                     "data", "models", "ckpt", "training", "tooling",
-                    "serving")),
+                    "fleet", "serving")),
 )
 
 #: training-side modules serving must never import at ANY depth (PR 6's
@@ -108,6 +113,22 @@ SERVING_FORBIDDEN_IMPORTS = (
     f"{PKG}.resilience.sentinel",
     f"{PKG}.resilience.watchdog",
     f"{PKG}.resilience.faults",
+    # serving ⊥ fleet (ISSUE 11): a replica must not reach into the
+    # scheduler that may be preempting it — coordination flows the other
+    # way, through processes and exit codes
+    f"{PKG}.fleet",
+)
+
+#: the mirror half of the serving ⊥ fleet wall, any depth: the scheduler
+#: composes training JOBS as subprocesses; importing the machinery it
+#: supervises (even lazily) would couple its process lifetime to a jax
+#: runtime it exists to babysit
+FLEET_FORBIDDEN_IMPORTS = (
+    f"{PKG}.serving",
+    f"{PKG}.parallel",
+    f"{PKG}.models",
+    f"{PKG}.ops",
+    f"{PKG}.launcher",
 )
 
 #: subpackages that must stay import leaves at ANY depth: everything
@@ -299,6 +320,14 @@ class ImportDagRule(Rule):
                         src, lineno, 0,
                         f"serving imports training machinery {imp} — the "
                         f"inference path must stay a read-only consumer")
+        if _under(mod, f"{PKG}.fleet"):
+            for lineno, imp in _all_imports(src.tree):
+                if any(_under(imp, bad) for bad in FLEET_FORBIDDEN_IMPORTS):
+                    yield self.finding(
+                        src, lineno, 0,
+                        f"fleet imports {imp} — the scheduler supervises "
+                        f"training/serving as subprocesses and must never "
+                        f"import that machinery, even lazily")
         for leaf, ok_prefixes in LEAF_SUBPACKAGES.items():
             if not _under(mod, leaf):
                 continue

@@ -85,6 +85,7 @@ SWALLOW_ALLOWLIST = {
     ("theanompi_tpu/launcher.py", "main"),           # exit-code contract
     ("theanompi_tpu/serving/cli.py", "main"),        # tmserve contract
     ("theanompi_tpu/analysis/cli.py", "main"),       # tmlint contract
+    ("theanompi_tpu/fleet/cli.py", "main"),          # tmfleet contract
 }
 
 _BROAD = {"Exception", "BaseException"}

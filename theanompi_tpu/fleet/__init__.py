@@ -1,0 +1,47 @@
+"""Multi-job fleet orchestration on the elastic supervisor (ISSUE 11).
+
+One device pool, many queued training jobs: the :class:`DeviceLedger`
+gang-leases devices all-or-nothing, the :class:`FleetScheduler` places
+queued :class:`JobSpec`\\ s in priority order as supervised children
+(the shared :func:`~theanompi_tpu.resilience.supervisor.run_job` seam),
+and priority contention is resolved by *elastic preemption*: the victim
+SIGTERMs out with a cadence checkpoint + data cursor (exit 75) and later
+resumes on whatever devices remain via ``--resume --resume-reshard`` —
+bit-equal params, gap-free data stream.
+
+The package imports only resilience/telemetry/utils (the ``tmlint``
+import DAG holds the wall): training and serving machinery is always a
+*subprocess*, never an import.
+"""
+
+from theanompi_tpu.fleet.jobs import (
+    STATUSES,
+    JobQueue,
+    JobRecord,
+    JobSpec,
+    JobSpecError,
+    build_child_cmd,
+    job_dir,
+    list_records,
+    read_record,
+    write_record,
+)
+from theanompi_tpu.fleet.ledger import DeviceLedger, LedgerError
+from theanompi_tpu.fleet.scheduler import FleetScheduler, read_fleet_events
+
+__all__ = [
+    "STATUSES",
+    "DeviceLedger",
+    "FleetScheduler",
+    "JobQueue",
+    "JobRecord",
+    "JobSpec",
+    "JobSpecError",
+    "LedgerError",
+    "build_child_cmd",
+    "job_dir",
+    "list_records",
+    "read_fleet_events",
+    "read_record",
+    "write_record",
+]

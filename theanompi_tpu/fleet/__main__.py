@@ -1,0 +1,6 @@
+"""``python -m theanompi_tpu.fleet`` == ``tmfleet``."""
+
+from theanompi_tpu.fleet.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,211 @@
+"""Job specs, runtime records, and the priority queue (ISSUE 11).
+
+A job is one supervised training session.  Everything it owns lives
+under ``<fleet_dir>/jobs/<id>/`` — checkpoint dir, telemetry dir, the
+supervisor's ``resilience.json``, and the crash-safe ``job.json``
+runtime record — so concurrent children never share mutable state, and
+a fleet dir survives a scheduler restart with every job's lifecycle
+intact.
+
+The spec carries the launch intent (rule, model, config, device range,
+priority); the record carries where the job is in its lifecycle::
+
+    queued -> running -> done | failed
+                 |  ^
+                 v  |            (priority preemption: SIGTERM -> exit 75
+           preempting            with a cadence checkpoint + data cursor,
+                 |               then an elastic relaunch on whatever
+                 v               devices remain: --resume --resume-reshard)
+             preempted -> queued'
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import sys
+
+_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+
+#: every lifecycle state a ``job.json`` may carry
+STATUSES = ("queued", "running", "preempting", "preempted", "done",
+            "failed")
+TERMINAL = ("done", "failed")
+
+
+class JobSpecError(ValueError):
+    """A job that cannot be scheduled as asked (the config-error class:
+    ``tmfleet`` maps it to exit 78, and it is never retried)."""
+
+
+@dataclasses.dataclass
+class JobSpec:
+    """The submit-time half of a job: what to run and what it needs."""
+
+    job_id: str
+    priority: int = 0
+    min_devices: int = 1            #: gang size floor (all-or-nothing)
+    max_devices: int | None = None  #: cap; None = take whatever is free
+    rule: str = "BSP"
+    modelfile: str = "theanompi_tpu.models.wide_resnet"
+    modelclass: str = "WideResNet"
+    model_config: dict = dataclasses.field(default_factory=dict)
+    rule_config: dict = dataclasses.field(default_factory=dict)
+    env: dict = dataclasses.field(default_factory=dict)
+    extra_args: list = dataclasses.field(default_factory=list)
+    max_restarts: int = 3
+    backoff_base: float = 0.1
+    #: test seam: an explicit child argv replaces the launcher command
+    #: entirely (scheduler unit tests run ``python -c`` children with no
+    #: jax import; such a job manages its own resume semantics)
+    argv: list | None = None
+
+    def validate(self) -> None:
+        if not isinstance(self.job_id, str) or not _ID_RE.match(self.job_id):
+            raise JobSpecError(
+                f"invalid job id {self.job_id!r} (letters, digits, "
+                f"'.', '_', '-'; must not start with a separator)")
+        if int(self.min_devices) < 1:
+            raise JobSpecError(
+                f"job {self.job_id!r}: min_devices must be >= 1, "
+                f"got {self.min_devices}")
+        if (self.max_devices is not None
+                and int(self.max_devices) < int(self.min_devices)):
+            raise JobSpecError(
+                f"job {self.job_id!r}: max_devices {self.max_devices} < "
+                f"min_devices {self.min_devices}")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "JobSpec":
+        names = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(d) - names)
+        if unknown:
+            raise JobSpecError(f"unknown job-spec keys {unknown}")
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class JobRecord:
+    """The runtime half: spec + lifecycle, persisted as ``job.json``."""
+
+    spec: JobSpec
+    status: str = "queued"
+    devices: int | None = None     #: current lease (None when not running)
+    preemptions: int = 0
+    episodes: int = 0
+    last_exit: int | None = None
+    #: exit code of each preempted episode, in order — durable witness
+    #: that victims left cooperatively (75 = cadence checkpoint written),
+    #: since ``last_exit`` is overwritten by the resumed episode
+    preempt_exits: list = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "JobRecord":
+        d = dict(d)
+        spec = JobSpec.from_dict(d.pop("spec"))
+        if d.get("status") not in STATUSES:
+            raise JobSpecError(f"unknown job status {d.get('status')!r}")
+        return cls(spec=spec, **d)
+
+
+def job_dir(fleet_dir: str, job_id: str) -> str:
+    return os.path.join(fleet_dir, "jobs", job_id)
+
+
+def write_record(fleet_dir: str, rec: JobRecord) -> str:
+    """Atomic ``job.json`` publish (same tmp+replace pattern as every
+    other artifact in the tree)."""
+    jdir = job_dir(fleet_dir, rec.spec.job_id)
+    os.makedirs(jdir, exist_ok=True)
+    path = os.path.join(jdir, "job.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump(rec.to_dict(), f, indent=1)
+    os.replace(path + ".tmp", path)
+    return path
+
+
+def read_record(fleet_dir: str, job_id: str) -> JobRecord:
+    with open(os.path.join(job_dir(fleet_dir, job_id), "job.json")) as f:
+        return JobRecord.from_dict(json.load(f))
+
+
+def list_records(fleet_dir: str) -> list[JobRecord]:
+    """Every persisted job record in the fleet dir, by job id."""
+    root = os.path.join(fleet_dir, "jobs")
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for jid in sorted(os.listdir(root)):
+        if os.path.isfile(os.path.join(root, jid, "job.json")):
+            out.append(read_record(fleet_dir, jid))
+    return out
+
+
+def build_child_cmd(spec: JobSpec, devices: int, jdir: str, *,
+                    resume: bool = False) -> list[str]:
+    """The child argv for one episode of ``spec`` gang-scheduled onto
+    ``devices`` workers.  Config values round-trip through the
+    launcher's ``--set`` literal grammar via ``repr`` (``'fp32'`` stays
+    a string, ``4`` an int).  ``resume=True`` is the elastic relaunch
+    after a preemption: ``--resume --resume-reshard`` replans the
+    cadence checkpoint onto the new device count, and the sample cursor
+    (PR 9) fast-forwards the data stream — nothing replayed or skipped
+    across the shrink."""
+    if spec.argv is not None:
+        return list(spec.argv)
+    cmd = [sys.executable, "-m", "theanompi_tpu.launcher",
+           "--rule", spec.rule, "--devices", str(int(devices)),
+           "--modelfile", spec.modelfile, "--modelclass", spec.modelclass]
+    for k, v in spec.model_config.items():
+        cmd += ["--set", f"{k}={v!r}"]
+    for k, v in spec.rule_config.items():
+        cmd += ["--rule-set", f"{k}={v!r}"]
+    cmd += ["--checkpoint-dir", os.path.join(jdir, "ckpt"), "--quiet"]
+    cmd += [str(a) for a in spec.extra_args]
+    if resume:
+        cmd += ["--resume", "--resume-reshard"]
+    return cmd
+
+
+class JobQueue:
+    """Runnable specs, highest priority first, FIFO within a band.
+
+    Preempted jobs re-enter through :meth:`push` and keep their original
+    submit sequence, so a requeued victim does not jump peers that were
+    already waiting at its priority.
+    """
+
+    def __init__(self):
+        self._seq = 0
+        self._items: list[tuple[int, int, JobSpec]] = []
+        self._seqs: dict[str, int] = {}
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __contains__(self, job_id: str) -> bool:
+        return any(s.job_id == job_id for _, _, s in self._items)
+
+    def push(self, spec: JobSpec) -> None:
+        spec.validate()
+        if spec.job_id in self:
+            raise JobSpecError(f"job {spec.job_id!r} is already queued")
+        seq = self._seqs.setdefault(spec.job_id, self._seq)
+        self._seq = max(self._seq, seq + 1)
+        self._items.append((-int(spec.priority), seq, spec))
+
+    def ordered(self) -> list[JobSpec]:
+        """Snapshot in scheduling order (does not consume)."""
+        return [s for _, _, s in sorted(self._items,
+                                        key=lambda t: (t[0], t[1]))]
+
+    def remove(self, job_id: str) -> None:
+        self._items = [t for t in self._items if t[2].job_id != job_id]

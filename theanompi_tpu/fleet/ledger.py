@@ -1,0 +1,151 @@
+"""Device-pool ledger: inventory + per-job gang leases (ISSUE 11).
+
+The fleet's single source of truth for "who holds which devices".  The
+pool is an integer inventory (TPU slices hand out chips by count, and
+the launcher's ``--devices N`` operand is how a child claims them), the
+leases are per-job counts, and allocation is **all-or-nothing gang
+allocation** — a training job steps collectively across every worker, so
+a partial grant would deadlock it at the first collective.
+
+State is crash-safe JSON in the fleet dir with a two-generation publish:
+every persist atomically rotates the live file to ``ledger.json.prev``
+before the new generation replaces ``ledger.json``, so a torn main file
+(power cut mid-publish; rehearsed by the ``fleet:ledger_torn_write``
+fault) recovers from the previous generation instead of crashing the
+scheduler.  Pool discovery reuses the elastic supervisor's probe seam
+(:func:`~theanompi_tpu.resilience.supervisor.probe_device_count`,
+ISSUE 8) when no explicit size is given.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from theanompi_tpu.resilience.supervisor import probe_device_count
+
+
+class LedgerError(RuntimeError):
+    """The pool state is unusable (no size, impossible lease, torn state
+    with no recoverable generation)."""
+
+
+class DeviceLedger:
+    """Device inventory with per-job leases and crash-safe persistence.
+
+    ``pool_size=None`` re-opens a persisted ledger (the size is part of
+    the state) or, for a fresh fleet dir, probes the live inventory.
+    ``fault_plan`` wires the ``fleet:ledger_torn_write@idx`` site: the
+    persist at ordinal ``idx`` tears the just-published main file in
+    half, exactly what a power cut mid-publish leaves behind.
+    """
+
+    def __init__(self, fleet_dir: str, pool_size: int | None = None, *,
+                 fault_plan=None, probe_env: dict | None = None):
+        os.makedirs(fleet_dir, exist_ok=True)
+        self.path = os.path.join(fleet_dir, "ledger.json")
+        self.fault_plan = fault_plan
+        self._persists = 0
+        state = self._load()
+        if state is not None:
+            self.pool_size = int(state["pool_size"])
+            self.leases = {str(k): int(v)
+                           for k, v in state["leases"].items()}
+            if pool_size is not None and int(pool_size) != self.pool_size:
+                raise LedgerError(
+                    f"--pool-size {pool_size} conflicts with the persisted "
+                    f"ledger's {self.pool_size} ({self.path}); remove the "
+                    f"ledger to re-inventory the pool")
+        else:
+            if pool_size is None:
+                pool_size = probe_device_count(probe_env, log=self._log)
+            if pool_size is None or int(pool_size) < 1:
+                raise LedgerError(
+                    "cannot size the device pool: no explicit pool size, "
+                    "no persisted ledger, and the device probe failed")
+            self.pool_size = int(pool_size)
+            self.leases: dict[str, int] = {}
+            self.persist()
+
+    # -- leases --------------------------------------------------------------
+    @property
+    def free(self) -> int:
+        return self.pool_size - sum(self.leases.values())
+
+    def lease_of(self, job_id: str) -> int:
+        return self.leases.get(job_id, 0)
+
+    def alloc(self, job_id: str, n: int) -> bool:
+        """All-or-nothing gang allocation: lease exactly ``n`` devices to
+        ``job_id`` and persist, or change nothing and return False."""
+        n = int(n)
+        if n < 1 or n > self.pool_size:
+            raise LedgerError(
+                f"job {job_id!r} asked for {n} device(s) from a pool "
+                f"of {self.pool_size}")
+        if job_id in self.leases:
+            raise LedgerError(f"job {job_id!r} already holds a lease "
+                              f"({self.leases[job_id]} device(s))")
+        if n > self.free:
+            return False
+        self.leases[job_id] = n
+        self.persist()
+        return True
+
+    def release(self, job_id: str) -> int:
+        """Drop ``job_id``'s lease; -> how many devices came free (0 when
+        it held none — releasing twice is not an error: the episode
+        thread and a crash-recovery sweep may race benignly)."""
+        freed = self.leases.pop(job_id, 0)
+        if freed:
+            self.persist()
+        return freed
+
+    # -- crash-safe persistence ----------------------------------------------
+    def persist(self) -> None:
+        data = {"version": 1, "pool_size": self.pool_size,
+                "leases": dict(sorted(self.leases.items())),
+                "generation": self._persists}
+        with open(self.path + ".tmp", "w") as f:
+            json.dump(data, f, indent=1)
+        if os.path.exists(self.path):
+            # rotate BEFORE the new generation lands: a crash between the
+            # two renames leaves .prev whole, which _load falls back to
+            os.replace(self.path, self.path + ".prev")
+        os.replace(self.path + ".tmp", self.path)
+        ordinal = self._persists
+        self._persists += 1
+        if self.fault_plan is not None and self.fault_plan.fire(
+                "fleet", ordinal, action="ledger_torn_write") is not None:
+            self._log(f"injected torn write on persist {ordinal}")
+            with open(self.path, "r+b") as f:
+                f.truncate(max(1, os.path.getsize(self.path) // 2))
+
+    def _load(self) -> dict | None:
+        """The persisted state, falling back one generation on a torn
+        main file; None when no generation exists (fresh pool)."""
+        torn: Exception | None = None
+        for path in (self.path, self.path + ".prev"):
+            try:
+                with open(path) as f:
+                    state = json.load(f)
+                if "pool_size" not in state or "leases" not in state:
+                    raise LedgerError(f"{path} is missing required keys")
+            except FileNotFoundError:
+                continue
+            except (ValueError, LedgerError) as e:
+                torn = e
+                continue
+            if torn is not None:
+                self._log(f"recovered pool state from {path} "
+                          f"(main generation torn: {torn})")
+            return state
+        if torn is not None:
+            raise LedgerError(
+                f"every ledger generation is unreadable: {torn}")
+        return None
+
+    @staticmethod
+    def _log(msg: str) -> None:
+        print(f"fleet: ledger: {msg}", file=sys.stderr, flush=True)

@@ -1,0 +1,346 @@
+"""Gang scheduler: queued jobs onto free devices, with priority
+preemption and elastic resume (ISSUE 11 tentpole).
+
+One scheduler owns one fleet dir.  Each scheduling pass walks the queue
+in priority order and gang-allocates the head job from the ledger; when
+the pool cannot fit it, lower-priority running jobs are preempted —
+SIGTERMed through their :class:`~theanompi_tpu.resilience.supervisor.
+Supervisor`, whose child checkpoints at the preemption cadence and exits
+75 — and later resume **elastically** on whatever devices remain
+(``--resume --resume-reshard``; the PR 9 sample cursor keeps the data
+stream gap-free across the shrink, nothing replayed or skipped).
+
+Every job runs as a supervised child via the shared
+:func:`~theanompi_tpu.resilience.supervisor.run_job` seam — the exact
+per-attempt run/classify/backoff loop behind ``tmlauncher --supervise``
+— so a crash inside an episode is the *supervisor's* problem (restart in
+place, same lease); the fleet only sees episode boundaries.  Lifecycle
+decisions land twice: a ``fleet_events.jsonl`` audit line and a
+telemetry instant through the registered
+:data:`~theanompi_tpu.telemetry.metrics.FLEET_INSTANTS` names.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from theanompi_tpu.resilience import EXIT_CLEAN, EXIT_CRASH
+from theanompi_tpu.resilience.faults import FaultPlan
+from theanompi_tpu.resilience.supervisor import run_job
+from theanompi_tpu.fleet.jobs import (
+    TERMINAL,
+    JobQueue,
+    JobRecord,
+    JobSpec,
+    JobSpecError,
+    build_child_cmd,
+    job_dir,
+    read_record,
+    write_record,
+)
+from theanompi_tpu.fleet.ledger import DeviceLedger
+
+
+def read_fleet_events(fleet_dir: str) -> list[dict]:
+    """The fleet's audit log, one dict per lifecycle decision."""
+    path = os.path.join(fleet_dir, "fleet_events.jsonl")
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+class FleetScheduler:
+    """Multi-job gang scheduler over one device pool.
+
+    ``fault_plan`` (string or :class:`FaultPlan`) wires the two ``fleet``
+    sites — ``kill_job@idx`` SIGKILLs the idx-th *launched* child (the
+    job's supervisor restarts it in place), ``ledger_torn_write@idx``
+    tears the idx-th ledger persist.  Deliberately NOT read from
+    ``THEANOMPI_FAULT_PLAN``: that env var is for training processes,
+    and the scheduler scrubs it from every child env so a plan aimed at
+    the fleet never detonates inside a job (and vice versa).
+    """
+
+    def __init__(self, fleet_dir: str, pool_size: int | None = None, *,
+                 fault_plan: "str | FaultPlan | None" = None,
+                 poll_s: float = 0.05, env: dict | None = None,
+                 telemetry: bool = True, probe_env: dict | None = None):
+        self.fleet_dir = fleet_dir
+        os.makedirs(fleet_dir, exist_ok=True)
+        if isinstance(fault_plan, str):
+            fault_plan = FaultPlan.parse(fault_plan)
+        self.fault_plan = fault_plan
+        self.ledger = DeviceLedger(fleet_dir, pool_size,
+                                   fault_plan=fault_plan,
+                                   probe_env=probe_env)
+        self.poll_s = float(poll_s)
+        self.env = dict(env) if env else {}
+        self._lock = threading.RLock()
+        self.queue = JobQueue()
+        self.records: dict[str, JobRecord] = {}
+        self._threads: dict[str, threading.Thread] = {}
+        self._sups: dict[str, object] = {}
+        self._launches = 0
+        self._telemetry = None
+        self._telemetry_enabled = bool(telemetry)
+        self.events_path = os.path.join(fleet_dir, "fleet_events.jsonl")
+
+    # -- submission & adoption ------------------------------------------------
+    def submit(self, spec: JobSpec) -> JobRecord:
+        """Queue ``spec`` and persist its record; scheduling happens on
+        the run loop's next pass."""
+        spec.validate()
+        with self._lock:
+            if spec.min_devices > self.ledger.pool_size:
+                raise JobSpecError(
+                    f"job {spec.job_id!r} needs min_devices="
+                    f"{spec.min_devices} but the pool has only "
+                    f"{self.ledger.pool_size}")
+            if spec.job_id in self.records:
+                raise JobSpecError(
+                    f"job {spec.job_id!r} already exists in this fleet")
+            rec = JobRecord(spec=spec)
+            self.records[spec.job_id] = rec
+            self.queue.push(spec)
+            write_record(self.fleet_dir, rec)
+            return rec
+
+    def adopt(self, rec: JobRecord) -> None:
+        """Re-own a persisted record from a dead scheduler.  A job that
+        was mid-flight when that scheduler died left a cadence
+        checkpoint behind, so it re-enters as ``preempted`` and resumes
+        elastically like any preemption victim."""
+        with self._lock:
+            if rec.spec.job_id in self.records:
+                raise JobSpecError(
+                    f"job {rec.spec.job_id!r} already exists in this fleet")
+            if rec.status in ("running", "preempting"):
+                rec.status = "preempted"
+                rec.devices = None
+                write_record(self.fleet_dir, rec)
+            self.records[rec.spec.job_id] = rec
+            if rec.status in ("queued", "preempted"):
+                self.queue.push(rec.spec)
+            # stale leases from the dead scheduler's ledger generation
+            self.ledger.release(rec.spec.job_id)
+
+    # -- events ---------------------------------------------------------------
+    def _event(self, name: str, **fields) -> None:
+        line = {"ts": time.time(),  # lint: wall-ok — audit log stamp
+                "event": name, **fields}
+        with open(self.events_path, "a") as f:
+            f.write(json.dumps(line) + "\n")
+        if self._telemetry is not None:
+            self._telemetry.instant(name, **fields)
+
+    # -- the run loop ---------------------------------------------------------
+    def run(self) -> int:
+        """Schedule until every submitted job is terminal; -> EXIT_CLEAN
+        when all jobs completed, EXIT_CRASH when any failed."""
+        if self._telemetry_enabled and self._telemetry is None:
+            from theanompi_tpu.telemetry import Telemetry
+
+            self._telemetry = Telemetry(
+                os.path.join(self.fleet_dir, "telemetry"), rank=0)
+        try:
+            while True:
+                with self._lock:
+                    self._reap()
+                    self._adopt_new()
+                    self._schedule_pass()
+                    if self.records and all(
+                            r.status in TERMINAL
+                            for r in self.records.values()):
+                        break
+                    if not self.records:
+                        break
+                time.sleep(self.poll_s)
+        finally:
+            for t in list(self._threads.values()):
+                t.join()
+            if self._telemetry is not None:
+                self._telemetry.close()
+                self._telemetry = None
+        failed = [j for j, r in self.records.items() if r.status == "failed"]
+        return EXIT_CRASH if failed else EXIT_CLEAN
+
+    def _reap(self) -> None:
+        for jid, t in list(self._threads.items()):
+            if not t.is_alive():
+                t.join()
+                del self._threads[jid]
+                self._sups.pop(jid, None)
+
+    def _adopt_new(self) -> None:
+        """Pick up ``queued`` records another process published into the
+        fleet dir since the last pass — the live half of ``tmfleet
+        submit`` (the BASELINE step-8 flow: a high-priority job submitted
+        while ``tmfleet run`` owns the pool must contend NOW, not on the
+        next scheduler start).  Only fresh ``queued`` records qualify;
+        anything mid-lifecycle belongs to startup adoption."""
+        root = os.path.join(self.fleet_dir, "jobs")
+        try:
+            found = sorted(os.listdir(root))
+        except OSError:
+            return
+        for jid in found:
+            if jid in self.records:
+                continue
+            try:
+                rec = read_record(self.fleet_dir, jid)
+            except (OSError, ValueError):
+                continue  # no job.json yet, or a foreign dir entry
+            if rec.status != "queued":
+                continue
+            try:
+                self.submit(rec.spec)
+            except JobSpecError as e:
+                # an unschedulable live submit must not wedge the loop:
+                # mark it failed on disk so `tmfleet status` shows why
+                rec.status = "failed"
+                write_record(self.fleet_dir, rec)
+                self.records[jid] = rec
+                self._event("fleet.fail", job=jid, exit_code=None,
+                            cause=f"config: {e}")
+
+    def _schedule_pass(self) -> None:
+        """One pass: place the highest-priority queued job, preempting
+        strictly-lower-priority running jobs when the free pool cannot
+        fit its gang.  Strict priority order — an unschedulable head
+        blocks the pass (no backfill past it), so a big high-priority
+        job cannot be starved by a stream of small ones."""
+        for spec in self.queue.ordered():
+            rec = self.records[spec.job_id]
+            n_min = int(spec.min_devices)
+            if self.ledger.free >= n_min:
+                n = (self.ledger.free if spec.max_devices is None
+                     else min(int(spec.max_devices), self.ledger.free))
+                self.queue.remove(spec.job_id)
+                self._launch(rec, n)
+                continue
+            # devices already draining toward us?
+            pending = sum(r.devices or 0 for r in self.records.values()
+                          if r.status == "preempting")
+            if self.ledger.free + pending >= n_min:
+                break  # wait for the drain, don't double-preempt
+            victims = sorted(
+                (r for r in self.records.values()
+                 if r.status == "running"
+                 and r.spec.priority < spec.priority),
+                key=lambda r: (r.spec.priority, r.spec.job_id))
+            avail = self.ledger.free + pending
+            for victim in victims:
+                if avail >= n_min:
+                    break
+                avail += victim.devices or 0
+                self._preempt(victim, for_job=spec.job_id)
+            break  # head job owns the pass until it launches
+
+    def _launch(self, rec: JobRecord, n: int) -> None:
+        jid = rec.spec.job_id
+        if not self.ledger.alloc(jid, n):
+            # raced a release between the free check and here; requeue
+            self.queue.push(rec.spec)
+            return
+        resume = rec.status == "preempted"
+        rec.status = "running"
+        rec.devices = n
+        rec.episodes += 1
+        write_record(self.fleet_dir, rec)
+        self._event("fleet.resume" if resume else "fleet.schedule",
+                    job=jid, devices=n, priority=rec.spec.priority)
+        kill_child = (
+            self.fault_plan is not None
+            and self.fault_plan.fire("fleet", self._launches,
+                                     action="kill_job") is not None)
+        self._launches += 1
+        t = threading.Thread(
+            target=self._episode, args=(rec, n, resume, kill_child),
+            name=f"fleet-{jid}", daemon=True)
+        self._threads[jid] = t
+        t.start()
+
+    def _preempt(self, rec: JobRecord, *, for_job: str) -> None:
+        jid = rec.spec.job_id
+        rec.status = "preempting"
+        write_record(self.fleet_dir, rec)
+        self._event("fleet.preempt", job=jid, victim_of=for_job)
+        sup = self._sups.get(jid)
+        if sup is not None:
+            sup.terminate()
+        # else: the episode thread has not registered its Supervisor yet;
+        # its on_supervisor callback sees status == "preempting" and
+        # terminates immediately (no lost preemption).
+
+    # -- one supervised episode (worker thread) -------------------------------
+    def _episode(self, rec: JobRecord, n: int, resume: bool,
+                 kill_child: bool) -> None:
+        jid = rec.spec.job_id
+        jdir = job_dir(self.fleet_dir, jid)
+        cmd = build_child_cmd(rec.spec, n, jdir, resume=resume)
+        # scrub the scheduler's own fault plan; a plan aimed at the fleet
+        # must never detonate inside a training child
+        env = {"THEANOMPI_FAULT_PLAN": ""}
+        env.update(self.env)
+        env.update(rec.spec.env)
+
+        def on_sup(sup):
+            with self._lock:
+                self._sups[jid] = sup
+                preempting = rec.status == "preempting"
+            if preempting:
+                sup.terminate()
+            if kill_child:
+                threading.Thread(target=self._kill_when_up, args=(sup,),
+                                 daemon=True).start()
+
+        result = run_job(
+            cmd, on_supervisor=on_sup,
+            max_restarts=rec.spec.max_restarts,
+            backoff_base=rec.spec.backoff_base,
+            resilience_path=os.path.join(jdir, "resilience.json"),
+            telemetry_dir=os.path.join(jdir, "telemetry"),
+            env=env)
+        with self._lock:
+            self.ledger.release(jid)
+            rec.devices = None
+            rec.last_exit = result.exit_code
+            if result.preempted:
+                rec.status = "preempted"
+                rec.preemptions += 1
+                rec.preempt_exits.append(result.exit_code)
+                self.queue.push(rec.spec)
+            elif result.clean:
+                rec.status = "done"
+                self._event("fleet.complete", job=jid,
+                            exit_code=result.exit_code)
+            else:
+                rec.status = "failed"
+                self._event("fleet.fail", job=jid,
+                            exit_code=result.exit_code, cause=result.cause)
+            write_record(self.fleet_dir, rec)
+
+    @staticmethod
+    def _kill_when_up(sup) -> None:
+        """fleet:kill_job delivery: SIGKILL the supervised child as soon
+        as its process exists (the supervisor then classifies a crash
+        and restarts it in place — the fleet sees one episode)."""
+        deadline = time.perf_counter() + 30.0
+        while time.perf_counter() < deadline:
+            p = getattr(sup, "_proc", None)
+            if p is not None:
+                try:
+                    p.kill()
+                except OSError:  # lint: swallow-ok — child already gone
+                    pass
+                return
+            time.sleep(0.01)

@@ -243,7 +243,7 @@ def _supervise(argv: list[str], args) -> int:
     actual session runs in child launcher processes (a fresh process is
     the only thing a SIGKILL/OOM/wedged-runtime can't take down with it,
     and the only way to re-init a jax backend cleanly)."""
-    from theanompi_tpu.resilience import EXIT_CONFIG, Supervisor, supervised
+    from theanompi_tpu.resilience import EXIT_CONFIG, run_job, supervised
 
     if supervised():
         # belt-and-braces recursion guard: a supervised child must never
@@ -262,7 +262,9 @@ def _supervise(argv: list[str], args) -> int:
     heartbeat = _supervisor_heartbeat_path(args, base)
     child = ([sys.executable, "-m", "theanompi_tpu.launcher"]
              + _strip_supervision_args(argv))
-    sup = Supervisor(
+    # the per-attempt run/classify/backoff core is the shared run_job
+    # seam — the fleet scheduler drives the same loop for its children
+    return run_job(
         child,
         max_restarts=args.max_restarts,
         backoff_base=args.backoff_base,
@@ -276,8 +278,7 @@ def _supervise(argv: list[str], args) -> int:
         elastic=args.elastic,
         resume_args=(("--resume", "--resume-reshard") if args.elastic
                      else ("--resume",)),
-    )
-    return sup.run()
+    ).exit_code
 
 
 def _compile_cache_usable(args) -> bool:

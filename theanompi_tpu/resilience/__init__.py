@@ -35,8 +35,11 @@ from theanompi_tpu.resilience.supervisor import (  # noqa: F401
     EXIT_HANG,
     EXIT_PREEMPTED,
     EXIT_RESHARD,
+    JobResult,
     Supervisor,
     classify_exit,
+    probe_device_count,
+    run_job,
 )
 from theanompi_tpu.resilience.events import (  # noqa: F401
     read_events,

@@ -38,12 +38,21 @@ specs separated by ``;`` or ``,``)::
                          supervisor attempt 2 (CheckpointReshardError ->
                          exit 79, which the supervisor classifies FATAL —
                          no restart loop over an unplannable transition)
+    fleet:kill_job@1     ISSUE 11: SIGKILL the fleet scheduler's 2nd
+                         launched child process (launch ordinal 1) — the
+                         job's supervisor classifies a crash and restarts
+                         it in place; the fleet sees one episode
+    fleet:ledger_torn_write@1  the 2nd ledger persist (ordinal 1) tears
+                         the main state file in half after commit — the
+                         next load must recover from the previous
+                         generation, not crash the scheduler
 
 ``INDEX`` is the global step for ``step``, the batch ordinal for
 ``prefetch``, the per-process read ordinal for ``data`` (every
 ``read_with_retry`` call draws the next ordinal; ``set_data_hooks``
-resets the counter), the epoch for ``checkpoint``, and the supervisor
-attempt for ``reshard``.  The optional ``ATTEMPT``
+resets the counter), the epoch for ``checkpoint``, the supervisor
+attempt for ``reshard``, and the launch/persist ordinal for ``fleet``.
+The optional ``ATTEMPT``
 gates a spec to one supervisor attempt (``THEANOMPI_ATTEMPT``, which the
 supervisor sets; unsupervised processes count as attempt 1) — a ``kill``
 spec under supervision should carry ``@1`` so the restarted attempt does
@@ -76,6 +85,7 @@ SITES = {
     "data": ("torn_read", "stall"),
     "checkpoint": ("fail", "truncate", "bitflip", "manifest_drop"),
     "reshard": ("fail",),
+    "fleet": ("kill_job", "ledger_torn_write"),
 }
 
 
@@ -95,11 +105,13 @@ class FaultSpec:
     attempt: int | None = None
     fired: bool = field(default=False, compare=False)
 
-    def matches(self, site: str, index: int) -> bool:
+    def matches(self, site: str, index: int,
+                action: str | None = None) -> bool:
         return (
             not self.fired
             and self.site == site
             and self.index == int(index)
+            and (action is None or self.action == action)
             and (self.attempt is None or self.attempt == current_attempt())
         )
 
@@ -159,11 +171,16 @@ class FaultPlan:
         text = spec or os.environ.get("THEANOMPI_FAULT_PLAN")
         return cls.parse(text) if text else None
 
-    def fire(self, site: str, index: int) -> str | None:
+    def fire(self, site: str, index: int,
+             action: str | None = None) -> str | None:
         """The action to inject at (site, index) now, or None.  Marks the
-        matched spec fired so it cannot trigger twice in one process."""
+        matched spec fired so it cannot trigger twice in one process.
+        ``action`` narrows the match to one action — for sites whose
+        actions count DIFFERENT ordinals (``fleet``: launch ordinal for
+        ``kill_job``, persist ordinal for ``ledger_torn_write``), so one
+        hook's counter cannot consume the other hook's spec."""
         for s in self.specs:
-            if s.matches(site, index):
+            if s.matches(site, index, action):
                 s.fired = True
                 return s.action
         return None

@@ -34,6 +34,7 @@ thread, enabled only when configured (``--hang-timeout``).
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import random
@@ -86,6 +87,65 @@ def classify_exit(returncode: int) -> str:
     if returncode in (EXIT_CONFIG, 2):
         return "config"
     return "crash"
+
+
+def probe_device_count(env: dict | None = None, *,
+                       timeout_s: float = 120.0, log=None) -> int | None:
+    """The live accelerator inventory, or ``None`` when unknowable.
+
+    Shared by the elastic :class:`Supervisor` (per-restart re-probe) and
+    the fleet ledger (device-pool discovery): the
+    ``THEANOMPI_ELASTIC_DEVICES`` env override first (operators who
+    already know the slice size), else a fresh ``python -c "import jax;
+    ..."`` subprocess — a SUBPROCESS because only an uninitialized
+    backend sees the current inventory (and this stdlib-only module must
+    not import jax).  A cpu-backend answer without an explicit
+    ``JAX_PLATFORMS`` cpu pin is a FAILED probe, not a 1-chip topology.
+    """
+    def _log(msg: str) -> None:
+        if log is not None:
+            log(msg)
+
+    def _valid(n: int, source: str) -> int | None:
+        if n < 1:
+            _log(f"ignoring nonsensical device count {n} from {source}")
+            return None
+        return n
+
+    override = os.environ.get("THEANOMPI_ELASTIC_DEVICES")
+    if override:
+        try:
+            return _valid(int(override), "THEANOMPI_ELASTIC_DEVICES")
+        except ValueError:
+            _log(f"ignoring non-integer "
+                 f"THEANOMPI_ELASTIC_DEVICES={override!r}")
+    env = dict(os.environ) if env is None else env
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()), "
+             "jax.default_backend())"],
+            env=env, capture_output=True, text=True, timeout=timeout_s)
+        if out.returncode != 0:
+            _log(f"device probe exited {out.returncode}: "
+                 f"{out.stderr.strip()[-200:]}")
+            return None
+        count_s, backend = out.stdout.strip().splitlines()[-1].split()
+        if backend == "cpu" and "cpu" not in env.get(
+                "JAX_PLATFORMS", "").lower():
+            # jax silently falls back to the CPU backend when an
+            # accelerator plugin fails to init: on a TPU VM that is a
+            # FAILED probe ("1 cpu device"), not a 1-chip topology —
+            # resharding onto it would keep "training" on host CPU
+            _log(f"device probe fell back to the cpu backend "
+                 f"({count_s} device(s)) but JAX_PLATFORMS does not pin "
+                 f"cpu; treating as a failed probe")
+            return None
+        return _valid(int(count_s), "jax probe")
+    except (OSError, subprocess.SubprocessError, ValueError,
+            IndexError) as e:
+        _log(f"device probe failed: {e}")
+        return None
 
 
 class Supervisor:
@@ -178,42 +238,9 @@ class Supervisor:
             except Exception as e:
                 self._log(f"injected device probe failed: {e}")
                 return None
-        override = os.environ.get("THEANOMPI_ELASTIC_DEVICES")
-        if override:
-            try:
-                return self._valid_count(int(override),
-                                         "THEANOMPI_ELASTIC_DEVICES")
-            except ValueError:
-                self._log(f"ignoring non-integer "
-                          f"THEANOMPI_ELASTIC_DEVICES={override!r}")
-        try:
-            env = self._attempt_env(attempt)
-            out = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(len(jax.devices()), "
-                 "jax.default_backend())"],
-                env=env, capture_output=True,
-                text=True, timeout=self.probe_timeout_s)
-            if out.returncode != 0:
-                self._log(f"device probe exited {out.returncode}: "
-                          f"{out.stderr.strip()[-200:]}")
-                return None
-            count_s, backend = out.stdout.strip().splitlines()[-1].split()
-            if backend == "cpu" and "cpu" not in env.get(
-                    "JAX_PLATFORMS", "").lower():
-                # jax silently falls back to the CPU backend when an
-                # accelerator plugin fails to init: on a TPU VM that is a
-                # FAILED probe ("1 cpu device"), not a 1-chip topology —
-                # resharding onto it would keep "training" on host CPU
-                self._log(f"device probe fell back to the cpu backend "
-                          f"({count_s} device(s)) but JAX_PLATFORMS does "
-                          f"not pin cpu; treating as a failed probe")
-                return None
-            return self._valid_count(int(count_s), "jax probe")
-        except (OSError, subprocess.SubprocessError, ValueError,
-                IndexError) as e:
-            self._log(f"device probe failed: {e}")
-            return None
+        return probe_device_count(self._attempt_env(attempt),
+                                  timeout_s=self.probe_timeout_s,
+                                  log=self._log)
 
     @staticmethod
     def _with_devices(cmd: list[str], n: int) -> list[str]:
@@ -289,6 +316,14 @@ class Supervisor:
                 p.terminate()
             except OSError:  # lint: swallow-ok — child already gone
                 pass
+
+    def terminate(self) -> None:
+        """Thread-safe preemption entry point (the fleet scheduler's):
+        act exactly as a delivered SIGTERM — forward it to the child
+        (whose cooperative handler checkpoints and exits 75), interrupt
+        any backoff wait, and end supervision after the child's
+        shutdown, never restarting."""
+        self._forward_term(signal.SIGTERM, None)
 
     # -- the loop ------------------------------------------------------------
     def run(self) -> int:
@@ -519,3 +554,41 @@ class Supervisor:
     @staticmethod
     def _log(msg: str) -> None:
         print(f"supervisor: {msg}", file=sys.stderr, flush=True)
+
+
+@dataclasses.dataclass
+class JobResult:
+    """What one supervised job episode came to (``run_job``'s return)."""
+
+    exit_code: int       #: the final exit code of the whole episode
+    cause: str           #: the LAST attempt's classification
+    attempts: list       #: per-attempt records (resilience.json shape)
+    preempted: bool      #: episode ended by preemption (resumable later)
+
+    @property
+    def clean(self) -> bool:
+        return self.exit_code == EXIT_CLEAN
+
+
+def run_job(child_cmd: list[str], *, on_supervisor=None,
+            **supervisor_kwargs) -> JobResult:
+    """One supervised job episode: the per-attempt run/classify/backoff
+    core behind both ``tmlauncher --supervise`` and the fleet scheduler.
+
+    Builds a :class:`Supervisor` over ``child_cmd`` (all keyword options
+    pass straight through) and runs it to a final exit code.
+    ``on_supervisor``, when given, receives the Supervisor before the
+    first attempt — the fleet scheduler registers the handle there so a
+    priority preemption can :meth:`Supervisor.terminate` the episode
+    from another thread.  ``run()`` installs its SIGTERM forwarder only
+    on the main thread, so calling this from worker threads is safe.
+    """
+    sup = Supervisor(child_cmd, **supervisor_kwargs)
+    if on_supervisor is not None:
+        on_supervisor(sup)
+    rc = sup.run()
+    cause = (sup.attempts[-1]["cause"] if sup.attempts
+             else classify_exit(rc))
+    return JobResult(
+        exit_code=rc, cause=cause, attempts=list(sup.attempts),
+        preempted=sup._terminated or classify_exit(rc) == "preemption")

@@ -87,6 +87,19 @@ RESHARD_INSTANTS = ("reshard.plan", "reshard.apply")
 # ONLY (same one-source-of-truth contract as the serving/reshard names).
 DATA_COUNTERS = ("data.retries",)
 
+# -- fleet instant names (ISSUE 11) ------------------------------------------
+# Scheduler lifecycle instants, mirrored from the fleet events log into the
+# fleet telemetry dir through these registered names ONLY (same
+# one-source-of-truth contract as the serving/reshard/data names).
+# ``fleet.schedule``: a queued job was gang-allocated devices and launched
+# (tags: job, devices, priority); ``fleet.preempt``: a running job was
+# SIGTERMed to free devices for a higher-priority one (tags: job, victim_of);
+# ``fleet.resume``: a preempted job relaunched elastically on the devices
+# that remain (tags: job, devices); ``fleet.complete``/``fleet.fail``: a
+# job's final episode ended (tags: job, exit_code).
+FLEET_INSTANTS = ("fleet.schedule", "fleet.preempt", "fleet.resume",
+                  "fleet.complete", "fleet.fail")
+
 
 class MetricsRegistry:
     """Named counters (monotonic totals), gauges (last value), histograms
