@@ -115,6 +115,56 @@ def make_wrn_trainer(mesh, checkpoint_dir, n_epochs=2, **kw):
     return t
 
 
+# -- exchange strategy-equivalence runs (ISSUE 12 satellite) ------------------
+
+#: the exchange-equivalence trainer config (test_exchanger / test_overlap
+#: build their shared runs from this — one source of truth)
+EXCHANGE_TINY = {
+    "depth": 10, "widen": 1, "batch_size": 2, "image_size": 8,
+    "n_train": 32, "n_val": 16, "n_epochs": 1, "precision": "fp32",
+    "augment": False, "verbose": False,
+}
+
+
+@pytest.fixture(scope="session")
+def exchange_run():
+    """Memoized two-step tiny-WRN training runs keyed by exchange config.
+
+    ``run(mesh, strategy, bucket_mb=4.0, overlap=False)`` ->
+    ``(trainer, params_as_numpy)``.  The strategy-equivalence matrix in
+    test_exchanger.py and the fused-vs-overlapped bit-equality locks in
+    test_overlap.py both compare runs against shared baselines; memoizing
+    at session scope trains each distinct configuration exactly once for
+    the whole tier-1 run (ROADMAP item 4 — the XLA compiles dominate).
+    Consumers treat the trainer AND the params as READ-ONLY.
+    """
+    import numpy as np
+
+    cache: dict = {}
+
+    def run(mesh, strategy, bucket_mb=4.0, overlap=False):
+        key = (id(mesh), strategy, float(bucket_mb), bool(overlap))
+        if key not in cache:
+            from theanompi_tpu.models.wide_resnet import WideResNet
+            from theanompi_tpu.parallel.bsp import BSPTrainer
+            from theanompi_tpu.utils.recorder import Recorder
+
+            model = WideResNet(dict(EXCHANGE_TINY))
+            t = BSPTrainer(model, mesh=mesh, exch_strategy=strategy,
+                           exch_bucket_mb=bucket_mb, exch_overlap=overlap,
+                           recorder=Recorder(verbose=False,
+                                             print_freq=10**9))
+            t.compile_iter_fns()
+            t.init_state()
+            for batch in list(model.data.train_batches(
+                    t.global_batch, 0, seed=0))[:2]:
+                t.train_iter(batch, lr=0.05)
+            cache[key] = (t, jax.tree.map(np.asarray, t.params))
+        return cache[key]
+
+    return run
+
+
 @pytest.fixture(scope="session")
 def trained_wrn_ckpt(tmp_path_factory, mesh4):
     """A completed 2-epoch tiny-WRN training run's checkpoint directory

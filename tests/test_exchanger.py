@@ -238,44 +238,27 @@ def test_zero1_wire_bytes_matches_scatter_gather_arithmetic():
 
 
 # -- full-train-step strategy equivalence (ISSUE 2 acceptance) ---------------
-
-TINY_WRN = {
-    "depth": 10, "widen": 1, "batch_size": 2, "image_size": 8,
-    "n_train": 32, "n_val": 16, "n_epochs": 1, "precision": "fp32",
-    "augment": False, "verbose": False,
-}
-
-
-def _train_two_steps(mesh, strategy, bucket_mb=4.0):
-    from theanompi_tpu.models.wide_resnet import WideResNet
-    from theanompi_tpu.parallel.bsp import BSPTrainer
-    from theanompi_tpu.utils.recorder import Recorder
-
-    model = WideResNet(dict(TINY_WRN))
-    t = BSPTrainer(model, mesh=mesh, exch_strategy=strategy,
-                   exch_bucket_mb=bucket_mb,
-                   recorder=Recorder(verbose=False, print_freq=10**9))
-    t.compile_iter_fns()
-    t.init_state()
-    for batch in list(model.data.train_batches(t.global_batch, 0, seed=0))[:2]:
-        t.train_iter(batch, lr=0.05)
-    return t, jax.tree.map(np.asarray, t.params)
+# The two-step runs live in conftest's session-scoped ``exchange_run``
+# fixture (ISSUE 12 satellite, ROADMAP item 4): the fused-vs-overlapped
+# bit-equality locks in test_overlap.py share these baselines instead of
+# retraining them per module.
 
 
 @pytest.fixture(scope="module")
-def psum_two_step_params(mesh4):
-    return _train_two_steps(mesh4, "psum")[1]
+def psum_two_step_params(mesh4, exchange_run):
+    return exchange_run(mesh4, "psum")[1]
 
 
 @pytest.mark.parametrize("strategy", ["psum_bucket", "ring_int8"])
-def test_train_step_matches_psum(mesh4, psum_two_step_params, strategy):
+def test_train_step_matches_psum(mesh4, exchange_run, psum_two_step_params,
+                                 strategy):
     """Acceptance: the new strategies' full BSP train step matches psum
     numerics on the 4-device CPU mesh within the documented tolerance
     (fp32 bucket layouts are reduction-order-identical — near-bit-exact;
     int8 carries its wire-format rounding).  The bf16/ring bucket variants'
     numerics are covered at exchange level by the mean matrix above —
     their train-step plumbing is identical to psum_bucket's."""
-    _, got = _train_two_steps(mesh4, strategy)
+    _, got = exchange_run(mesh4, strategy)
     tol = _tol(strategy)
     for a, b in zip(jax.tree.leaves(got),
                     jax.tree.leaves(psum_two_step_params)):
@@ -285,8 +268,8 @@ def test_train_step_matches_psum(mesh4, psum_two_step_params, strategy):
 # -- zero1 specifics (one shared training run) -------------------------------
 
 @pytest.fixture(scope="module")
-def zero1_run(mesh4):
-    return _train_two_steps(mesh4, "zero1")
+def zero1_run(mesh4, exchange_run):
+    return exchange_run(mesh4, "zero1")
 
 
 def test_zero1_train_step_matches_psum(zero1_run, psum_two_step_params):

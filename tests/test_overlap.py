@@ -1,0 +1,344 @@
+"""ISSUE 12: comm/compute-overlapped exchange + quantization ramp schedule.
+
+Locks the two contracts of ``theanompi_tpu/parallel/overlap.py``:
+
+- **Overlap bit-equality** (acceptance): with ``exch_overlap=True`` the
+  per-bucket collectives are chained into backward in reverse layout
+  order, but every fence is value-preserving — final params are
+  bit-equal to the fused path for ``psum_bucket`` and ``zero1`` on the
+  8-device CPU mesh (``ring_int8`` at its documented wire tolerance),
+  and the static wire/bucket accounting does not move at all.  The
+  schedule proof itself (collective→collective dependency edges in the
+  optimized HLO) lives in ``tests/test_hlo_audit.py``.
+
+- **Ramp phases switch only at fenced epoch boundaries**: the active
+  strategy is a pure function of the absolute epoch, the step fn
+  recompiles at most once per phase (no recompile storm), wire-byte
+  accounting tracks the active phase through telemetry, and a mid-ramp
+  checkpoint resume lands in the phase its epoch dictates.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from theanompi_tpu.parallel.exchanger import BUCKETED_STRATEGIES, Exchanger
+from theanompi_tpu.parallel.mesh import DATA_AXIS, shard_map
+from theanompi_tpu.parallel.overlap import RampSchedule
+from conftest import EXCHANGE_TINY  # noqa: E402
+
+#: small enough that the tiny WRN packs into several fp32 buckets — an
+#: overlap run with one bucket has no chain and proves nothing
+CHAIN_MB = 0.05
+
+
+def _leaves(tree):
+    return [np.asarray(x) for x in jax.tree.leaves(tree)]
+
+
+@pytest.fixture(scope="module")
+def mesh2():
+    """2-device data mesh for the compile-heavy integration tests below:
+    chain/ramp semantics are device-count-independent, and the unrolled
+    2(n-1) ppermute hops per bucket dominate the ring compiles, so the
+    smallest collective mesh keeps them inside the tier-1 budget."""
+    from theanompi_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(n_data=2, devices=jax.devices()[:2])
+
+
+# -- acceptance: fused-vs-overlapped bit-equality on mesh8 -------------------
+
+@pytest.mark.parametrize("strategy", ["psum_bucket", "zero1"])
+def test_overlap_bit_equal_on_mesh8(exchange_run, mesh8, strategy):
+    """Acceptance: two full train steps with the chained schedule produce
+    BIT-identical params to the fused schedule (the fences' true branch
+    returns each buffer verbatim; zero1's chain sits on the update
+    OUTPUTS precisely so XLA's fusion clusters — and therefore the FMA
+    contractions — do not move)."""
+    t_fused, fused = exchange_run(mesh8, strategy, bucket_mb=CHAIN_MB)
+    t_over, over = exchange_run(mesh8, strategy, bucket_mb=CHAIN_MB,
+                                overlap=True)
+    assert not t_fused.exchanger.overlap and t_over.exchanger.overlap
+    # the run must exercise a real chain, not a degenerate single bucket
+    n_buckets = t_over.exchanger.bucket_summary(
+        t_over.params, 8)["n_buckets"]
+    assert n_buckets >= 2, n_buckets
+    for a, b in zip(_leaves(fused), _leaves(over)):
+        np.testing.assert_array_equal(a, b)
+
+
+# -- exchange-level equivalence (every bucketed mean strategy) ---------------
+
+def _exchange_tree(mesh, strategy, per_dev, overlap):
+    """Run one multi-bucket exchange of ``per_dev`` (dict of [n, k] arrays
+    sharded over data) and return the per-device outputs as numpy."""
+    ex = Exchanger(strategy=strategy, bucket_bytes=256, overlap=overlap)
+    step = jnp.zeros((), jnp.int32)
+
+    def f(tree, step):
+        inner = jax.tree.map(lambda a: a[0], tree)
+        out = ex.exchange(inner, rng=jax.random.PRNGKey(3), step=step)
+        return jax.tree.map(lambda a: a[None], out)
+
+    out = shard_map(f, mesh=mesh, in_specs=(P(DATA_AXIS), P()),
+                    out_specs=P(DATA_AXIS), check=False)(per_dev, step)
+    return jax.tree.map(np.asarray, out)
+
+
+def _per_dev_tree(n, leaves=("a", "b", "c")):
+    rng = np.random.RandomState(0)
+    # leaves of 192 bytes each against bucket_bytes=256 -> one bucket per
+    # leaf, so len(leaves) buckets
+    return {k: jnp.asarray(rng.randn(n, 48).astype(np.float32))
+            for k in leaves}
+
+
+@pytest.mark.parametrize("strategy", ["psum_bucket", "ring_int8"])
+def test_overlap_exchange_matches_fused(mesh4, mesh2, strategy):
+    """The chained walk returns the same reduction as the fused walk
+    (bit-equal — ``ring_int8``'s rng folds by bucket INDEX, not walk
+    order, so even its stochastic rounding noise is identical; a tiny
+    atol keeps the lock honest about that claim without over-pinning
+    XLA) and the result is the cross-replica mean within the strategy's
+    documented wire tolerance.  Two representatives keep the matrix
+    inside the tier-1 budget: the fence/rng plumbing is
+    strategy-agnostic, so the ring/bf16 bucket variants add compile cost
+    but no coverage (their fused numerics are locked in
+    test_exchanger.py).  The ring case runs on the 2-device mesh with a
+    2-bucket tree (one chain edge) for the same reason; psum keeps the
+    deeper 3-bucket chain — its compiles are cheap."""
+    if strategy == "ring_int8":
+        mesh, n_dev, leaves = mesh2, 2, ("a", "b")
+    else:
+        mesh, n_dev, leaves = mesh4, 4, ("a", "b", "c")
+    per_dev = _per_dev_tree(n_dev, leaves)
+    fused = _exchange_tree(mesh, strategy, per_dev, overlap=False)
+    over = _exchange_tree(mesh, strategy, per_dev, overlap=True)
+    atol = 1e-6 if strategy == "ring_int8" else 0.0
+    for a, b in zip(_leaves(fused), _leaves(over)):
+        np.testing.assert_allclose(a, b, rtol=0, atol=atol)
+    tol = 5e-2 if "int8" in strategy else (1e-2 if "bf16" in strategy
+                                           else 1e-6)
+    for k, v in per_dev.items():
+        want = np.asarray(v).mean(axis=0)
+        for i in range(n_dev):
+            np.testing.assert_allclose(over[k][i], want, rtol=tol, atol=tol)
+
+
+def test_overlap_requires_bucketed_strategy():
+    with pytest.raises(ValueError, match="not bucketed"):
+        Exchanger(strategy="psum", overlap=True)
+
+
+def test_overlap_requires_step_scalar(mesh4):
+    """The fence chain is anchored on the traced step scalar; forgetting
+    to thread it through is a loud trace-time error, not a silent
+    unchained schedule."""
+    ex = Exchanger(strategy="psum_bucket", bucket_bytes=256, overlap=True)
+
+    def f(x):
+        return ex.exchange({"a": x[0]})["a"][None]
+
+    with pytest.raises(ValueError, match="step scalar"):
+        shard_map(f, mesh=mesh4, in_specs=P(DATA_AXIS),
+                  out_specs=P(DATA_AXIS), check=False)(jnp.ones((4, 48)))
+
+
+def test_overlap_changes_no_accounting():
+    """Satellite invariant: overlap is a schedule change, not a traffic
+    change — static wire bytes and the bucket layout are identical."""
+    tree = {"w": np.zeros((1000,), np.float32),
+            "b": np.zeros((10,), np.float32)}
+    for strategy in BUCKETED_STRATEGIES:
+        fused = Exchanger(strategy, bucket_bytes=1024)
+        over = Exchanger(strategy, bucket_bytes=1024, overlap=True)
+        assert fused.wire_bytes(tree, 8) == over.wire_bytes(tree, 8)
+        assert fused.bucket_summary(tree, 8) == over.bucket_summary(tree, 8)
+
+
+# -- RampSchedule parsing ----------------------------------------------------
+
+def test_ramp_parse_phases_and_lookup():
+    r = RampSchedule.parse("ring_int8:2,psum_bf16_bucket:4", "psum_bucket")
+    assert r.phases == (("ring_int8", 2), ("psum_bf16_bucket", 4),
+                        ("psum_bucket", None))
+    assert [r.strategy_for_epoch(e) for e in range(6)] == (
+        ["ring_int8"] * 2 + ["psum_bf16_bucket"] * 2 + ["psum_bucket"] * 2)
+    assert r.phase_for_epoch(0) == 0 and r.phase_for_epoch(99) == 2
+    assert r.describe() == "ring_int8:2,psum_bf16_bucket:4,psum_bucket"
+    assert r.strategies == ("ring_int8", "psum_bf16_bucket", "psum_bucket")
+
+
+@pytest.mark.parametrize("spec,base,msg", [
+    ("ring_int8", "psum_bucket", "strategy:until_epoch"),
+    ("ring_int8:x", "psum_bucket", "not an epoch"),
+    ("nope:2", "psum_bucket", "unknown"),
+    ("ring_int8:3,psum_bf16_bucket:2", "psum_bucket", "strictly increasing"),
+    ("ring_int8:2,psum_bf16_bucket:2", "psum_bucket", "strictly increasing"),
+    ("zero1:2", "psum_bucket", "zero1"),
+    ("ring_int8:2", "zero1", "zero1"),
+    ("", "psum_bucket", "empty"),
+])
+def test_ramp_parse_rejects(spec, base, msg):
+    with pytest.raises(ValueError, match=msg):
+        RampSchedule.parse(spec, base)
+
+
+# -- ramp integration: boundaries, telemetry, resume -------------------------
+
+def _ramp_trainer(mesh, n_epochs, telemetry=None, checkpoint_dir=None,
+                  ramp="ring_int8:1,psum_bf16_bucket:2"):
+    from theanompi_tpu.models.wide_resnet import WideResNet
+    from theanompi_tpu.parallel.bsp import BSPTrainer
+    from theanompi_tpu.utils.recorder import Recorder
+
+    model = WideResNet({**EXCHANGE_TINY, "n_epochs": n_epochs,
+                        "n_train": 16})
+    t = BSPTrainer(model, mesh=mesh, exch_strategy="psum_bucket",
+                   exch_bucket_mb=CHAIN_MB, exch_overlap=True,
+                   exch_ramp=ramp, telemetry=telemetry,
+                   checkpoint_dir=checkpoint_dir,
+                   recorder=Recorder(verbose=False, print_freq=10**9))
+    t.compile_iter_fns()
+    t.init_state()
+    return t
+
+
+def _spy_train_iter(t, seen):
+    orig = t.train_iter
+
+    def spy(batch, lr, recorder=None):
+        seen.append((t.epoch, t.exchanger.strategy, id(t._step_fn)))
+        return orig(batch, lr, recorder)
+
+    t.train_iter = spy
+
+
+def test_ramp_switches_only_at_epoch_boundaries(mesh2, tmp_path):
+    """Acceptance: over a 3-epoch run the active strategy follows the
+    ramp exactly — every step inside an epoch uses that epoch's phase,
+    each phase compiles its step fn ONCE (no recompile storm), and
+    telemetry carries the phase gauge + per-phase wire accounting."""
+    from theanompi_tpu.telemetry import Telemetry
+    from theanompi_tpu.telemetry.sink import read_events, sink_files
+
+    tel_dir = str(tmp_path / "tel")
+    tel = Telemetry(tel_dir)
+    t = _ramp_trainer(mesh2, n_epochs=3, telemetry=tel)
+    seen = []
+    _spy_train_iter(t, seen)
+    t.run()
+    tel.close()
+
+    by_epoch = {}
+    for epoch, strategy, fn_id in seen:
+        by_epoch.setdefault(epoch, []).append((strategy, fn_id))
+    assert sorted(by_epoch) == [0, 1, 2]
+    want = {0: "ring_int8", 1: "psum_bf16_bucket", 2: "psum_bucket"}
+    for epoch, steps in by_epoch.items():
+        # one strategy AND one compiled step fn per epoch
+        assert {s for s, _ in steps} == {want[epoch]}, (epoch, steps)
+        assert len({fid for _, fid in steps}) == 1, (epoch, steps)
+    # exactly one step fn per PHASE across the whole run
+    assert len({fid for _, _, fid in seen}) == 3
+
+    events = []
+    for p in sink_files(tel_dir):
+        events.extend(read_events(p))
+    switches = [e for e in events if e["name"] == "exchange.ramp_switch"]
+    assert [(e["epoch"], e["strategy"], e["phase"]) for e in switches] == [
+        (0, "ring_int8", 0), (1, "psum_bf16_bucket", 1),
+        (2, "psum_bucket", 2)]
+    gauges = [e for e in events if e["name"] == "exchange.ramp_phase"]
+    assert [e["value"] for e in gauges] == [0, 1, 2]
+    # wire-byte accounting re-emitted per phase, at the phase's wire dtype:
+    # int8 is exactly 1/4 and bf16 exactly 1/2 of the fp32 bucket bytes
+    acct = [e for e in events if e["name"] == "exchange.accounting"]
+    assert [e["strategy"] for e in acct] == [
+        "ring_int8", "psum_bf16_bucket", "psum_bucket"]
+    fp32 = acct[2]["bytes_per_exchange"]
+    assert acct[0]["bytes_per_exchange"] * 4 == fp32
+    assert acct[1]["bytes_per_exchange"] * 2 == fp32
+    # the overlap span marks each (re)arming of the chained step fn: the
+    # initial compile_iter_fns build + one per phase switch
+    arms = [e for e in events if e["name"] == "exchange.overlap"]
+    assert len(arms) == 4
+
+
+def test_ramp_resume_restores_phase(mesh2, tmp_path):
+    """Acceptance: the phase is a pure function of the absolute epoch, so
+    a mid-ramp checkpoint resume lands in the right phase with no extra
+    checkpoint state — and the resumed params lineage continues.  (A
+    cheap two-phase ramp: the full int8→bf16→exact spec is exercised in
+    test_ramp_switches_only_at_epoch_boundaries; re-compiling ring_int8's
+    chained walk here would add ~12s of tier-1 for no new coverage.)"""
+    ck = str(tmp_path / "ck")
+    ramp = "psum_bf16_bucket:1"
+    t1 = _ramp_trainer(mesh2, n_epochs=1, checkpoint_dir=ck, ramp=ramp)
+    t1.run()
+    assert t1.exchanger.strategy == "psum_bf16_bucket"  # ended mid-ramp
+
+    t2 = _ramp_trainer(mesh2, n_epochs=3, checkpoint_dir=ck, ramp=ramp)
+    assert t2.try_resume()
+    assert t2.epoch == 1
+    seen = []
+    _spy_train_iter(t2, seen)
+    t2.run()
+    want = {1: "psum_bucket", 2: "psum_bucket"}
+    assert {(e, s) for e, s, _ in seen} == set(want.items())
+    # phase 0's exchanger never ran (and never compiled) in the resume
+    assert all(s != "psum_bf16_bucket" for _, s, _ in seen)
+
+
+def test_ramp_and_overlap_stamp_the_fingerprint(mesh4):
+    """Changing the ramp or overlap knobs across a resume is a real
+    topology change (different wire numerics / schedule): both are
+    stamped, and the stamped exchange strategy is the ramp-invariant BASE
+    (the active exchanger varies by epoch)."""
+    from theanompi_tpu.models.wide_resnet import WideResNet
+    from theanompi_tpu.parallel.bsp import BSPTrainer
+    from theanompi_tpu.utils.recorder import Recorder
+
+    def fp(**kw):
+        t = BSPTrainer(WideResNet(dict(EXCHANGE_TINY)), mesh=mesh4,
+                       recorder=Recorder(verbose=False, print_freq=10**9),
+                       **kw)
+        return t._run_fingerprint()
+
+    plain = fp(exch_strategy="psum_bucket")
+    assert plain["exchange"] == "psum_bucket"
+    assert "exch_ramp" not in plain and "exch_overlap" not in plain
+
+    ramped = fp(exch_strategy="psum_bucket", exch_overlap=True,
+                exch_ramp="ring_int8:1")
+    assert ramped["exchange"] == "psum_bucket"  # base, not epoch-0 phase
+    assert ramped["exch_ramp"] == "ring_int8:1,psum_bucket"
+    assert ramped["exch_overlap"] is True
+
+
+def test_ramp_refuses_zero1_base_at_trainer_construction(mesh4):
+    from theanompi_tpu.models.wide_resnet import WideResNet
+    from theanompi_tpu.parallel.bsp import BSPTrainer
+    from theanompi_tpu.utils.recorder import Recorder
+
+    with pytest.raises(ValueError, match="zero1"):
+        BSPTrainer(WideResNet(dict(EXCHANGE_TINY)), mesh=mesh4,
+                   exch_strategy="zero1", exch_ramp="ring_int8:1",
+                   recorder=Recorder(verbose=False, print_freq=10**9))
+
+
+# -- telemetry names registry -------------------------------------------------
+
+def test_exchange_telemetry_names_registered():
+    """The overlap span and ramp gauge/instant are emitted through the
+    registered names ONLY (one-source-of-truth contract — same as the
+    serving/reshard/data/fleet names)."""
+    from theanompi_tpu.telemetry.metrics import (
+        EXCHANGE_GAUGES, EXCHANGE_INSTANTS, EXCHANGE_SPANS)
+
+    assert set(EXCHANGE_SPANS) == {"exchange.overlap"}
+    assert set(EXCHANGE_GAUGES) == {"exchange.ramp_phase"}
+    assert set(EXCHANGE_INSTANTS) == {"exchange.ramp_switch"}

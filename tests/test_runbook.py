@@ -63,6 +63,7 @@ def test_runbook_launcher_command(tmp_path):
             "--set", "shard_size=16", "--set", "precision=fp32",
             "--rule-set", "exch_strategy=psum_bf16_bucket",
             "--rule-set", "exch_bucket_mb=4",
+            "--rule-set", "exch_overlap=True",
             "--rule-set", "checkpoint_async=True",
             "--checkpoint-dir", ckpt, "--compile-cache-dir", cache,
             "--record-dir", record, "--telemetry-dir", telemetry, "--quiet",
@@ -185,14 +186,21 @@ def test_runbook_exchange_bench_command(tmp_path):
         "--set", "n_train=32", "--set", "n_val=16",
         "--set", "precision=fp32",
         "--strategies", "psum_bf16_bucket", "--bucket-mb", "4",
-        "--out", out,
+        "--overlap", "--out", out,
     ])
     art = json.load(open(out))
+    assert art["overlap"] is True
     row = art["per_strategy"]["psum_bf16_bucket"]
     assert row["wire_bytes_per_step"] > 0
     assert row["collectives"].get("all-reduce", 0) >= 1
     assert row["buckets"]["bucket_bytes"] == 4 * 2**20
     assert row["step_ms"] > 0
+    # the ISSUE 12 overlap column: fused-vs-overlapped step time, the
+    # collective-count invariant, and both differential comm shares
+    assert row["step_ms_overlap"] > 0
+    assert row["overlap_collectives_equal"] is True
+    assert 0.0 <= row["comm_share_differential"] <= 1.0
+    assert 0.0 <= row["comm_share_differential_overlap"] <= 1.0
 
 
 def test_runbook_serve_command(tmp_path, capsys):

@@ -78,6 +78,111 @@ def audit_text(hlo_text: str) -> dict:
     }
 
 
+# -- entry-computation dataflow (the overlap-schedule discriminator) ---------
+#
+# Text POSITION cannot prove a collective schedule: the CPU scheduler
+# already interleaves op definitions positionally even when the collectives
+# are mutually independent and free to sink to the end.  What the overlap
+# transform actually guarantees — and what survives every optimization
+# pass — is DATAFLOW: with ``exch_overlap`` on, bucket k+1's collective
+# transitively depends on bucket k's result (the select fence in
+# ``parallel/overlap.py``), while the fused schedule's per-bucket
+# collectives have no edges between them at all.  So the auditor parses
+# the optimized entry computation into an operand graph and counts
+# collective->collective reachability.
+
+_ENTRY_OP_RE = re.compile(r"\)?\s*([a-z][\w\-]*)\(")
+_ENTRY_OPERAND_RE = re.compile(r"%([\w.\-]+)")
+
+#: op kinds the chain discriminator follows (same spellings as
+#: ``telemetry.metrics.COLLECTIVE_OPS`` definitions)
+_CHAIN_COLLECTIVES = ("all-reduce", "reduce-scatter", "all-gather",
+                      "collective-permute")
+
+
+def entry_dependency_graph(hlo_text: str):
+    """Parse the ENTRY computation -> ``(graph, order)``.
+
+    ``graph`` maps instruction name -> ``(op_kind, operand_names)``;
+    ``order`` is definition order.  Operand extraction is by ``%name``
+    reference, which over-approximates (attribute refs like ``to_apply=``
+    point at non-entry computations and resolve to nothing) — safe for
+    reachability, which only follows names defined in the entry.
+    """
+    in_entry = False
+    graph: dict = {}
+    order: list = []
+    for ln in hlo_text.splitlines():
+        if ln.startswith("ENTRY"):
+            in_entry = True
+            continue
+        if in_entry:
+            if ln.startswith("}"):
+                break
+            s = ln.strip()
+            if " = " not in s:
+                continue
+            lhs, rhs = s.split(" = ", 1)
+            name = lhs.strip().removeprefix("ROOT ").lstrip("%")
+            m = _ENTRY_OP_RE.search(rhs)
+            op = m.group(1) if m else "?"
+            args = rhs.split("(", 1)[1] if "(" in rhs else ""
+            graph[name] = (op, _ENTRY_OPERAND_RE.findall(args))
+            order.append(name)
+    return graph, order
+
+
+def collective_chain_stats(hlo_text: str) -> dict:
+    """Dataflow facts that discriminate overlapped from fused schedules.
+
+    - ``chained_same_kind``: ordered pairs (A, B) of SAME-KIND collectives
+      where B transitively depends on A.  The overlap chain makes this
+      >= n_buckets - 1 (transitively n*(n-1)/2 for a full chain); the
+      fused schedule's grad collectives are mutually independent, so it
+      is 0.  Same-kind only, because zero1's all-gathers inherently
+      depend on reduce-scatters (through the update) in EITHER schedule.
+    - ``interleaved_pairs``: chained pairs whose downstream collective
+      depends on at least one fusion the upstream one does not — i.e.
+      backward compute sits ON the chain between the two collectives,
+      which is the overlap claim itself (comm k || compute k+1).
+    """
+    graph, order = entry_dependency_graph(hlo_text)
+    colls = [(n, graph[n][0]) for n in order
+             if graph[n][0] in _CHAIN_COLLECTIVES]
+    # transitive closure in definition order (operands precede uses in
+    # printed HLO, so one forward pass resolves every ancestor set; an
+    # iterative walk — entry computations run to thousands of ops)
+    memo: dict = {}
+    for name in order:
+        acc: set = set()
+        for o in graph[name][1]:
+            if o in graph:
+                acc.add(o)
+                acc |= memo.get(o, set())
+        memo[name] = acc
+
+    def ancestors(name):
+        return memo.get(name, set())
+
+    chained = 0
+    interleaved = 0
+    for b, kind_b in colls:
+        anc_b = ancestors(b)
+        for a, kind_a in colls:
+            if a == b or kind_a != kind_b or a not in anc_b:
+                continue
+            chained += 1
+            between = {x for x in anc_b - ancestors(a) - {a}
+                       if graph[x][0] in ("fusion", "convolution", "dot")}
+            if between:
+                interleaved += 1
+    return {
+        "n_collectives": len(colls),
+        "chained_same_kind": chained,
+        "interleaved_pairs": interleaved,
+    }
+
+
 # -- representative train step ----------------------------------------------
 
 #: depth 16 -> 43 param leaves: past the >=30-leaf bar the PR 2
@@ -107,11 +212,15 @@ TRAIN_COLLECTIVE_BUDGETS: dict[str, dict[str, tuple[int, int | None]]] = {
 
 
 @functools.lru_cache(maxsize=None)
-def _train_artifact(strategy: str, n_data: int = 4) -> dict:
+def _train_artifact(strategy: str, n_data: int = 4, overlap: bool = False,
+                    bucket_mb: float | None = None) -> dict:
     """Compile the BSP train step for ``strategy``; -> facts + HLO text.
 
-    Cached: one XLA compile per (strategy, mesh) per process, shared by
-    the legacy collective-lint shim and the audit tests.
+    Cached: one XLA compile per (strategy, mesh, overlap, bucket size)
+    per process, shared by the legacy collective-lint shim and the audit
+    tests.  ``bucket_mb`` shrinks the fused-bucket cap (the overlap audit
+    needs >= 2 grad buckets out of this tiny model; the default 4 MiB
+    packs everything into one).
     """
     import jax
 
@@ -123,8 +232,10 @@ def _train_artifact(strategy: str, n_data: int = 4) -> dict:
 
     model = WideResNet(dict(TRAIN_MODEL_CFG))
     mesh = make_mesh(n_data=n_data, devices=jax.devices()[:n_data])
+    kw = {} if bucket_mb is None else {"exch_bucket_mb": bucket_mb}
     t = BSPTrainer(model, mesh=mesh, exch_strategy=strategy,
-                   recorder=Recorder(verbose=False, print_freq=10**9))
+                   exch_overlap=overlap,
+                   recorder=Recorder(verbose=False, print_freq=10**9), **kw)
     t.compile_iter_fns()
     t.init_state()
     batch = shard_batch(
@@ -132,8 +243,12 @@ def _train_artifact(strategy: str, n_data: int = 4) -> dict:
         next(iter(model.data.train_batches(t.global_batch, 0, seed=0))),
         spec=t.batch_spec)
     text = t.compiled_step_text(batch)
+    buckets = t.exchanger.bucket_summary(
+        t._shard_param_structs(), t._exchange_axis_size())
     return {
         "n_param_leaves": len(jax.tree.leaves(t.params)),
+        "n_buckets": None if buckets is None else buckets["n_buckets"],
+        "chain": collective_chain_stats(text),
         **audit_text(text),
     }
 
@@ -179,6 +294,84 @@ def audit_train_step(strategy: str, n_data: int = 4) -> dict:
             f"{facts['host_callbacks']}")
     return {"kind": "train", "strategy": strategy, "n_data": n_data,
             "ok": not violations, "violations": violations, **facts}
+
+
+# -- overlapped-exchange schedule audit (ISSUE 12) ---------------------------
+
+#: bucket cap for the overlap artifacts — small enough that the depth-16
+#: WRN's fp32 grads split into several buckets (the chain needs >= 2)
+OVERLAP_AUDIT_BUCKET_MB = 0.125
+
+#: strategies the default overlap audit locks (the all-reduce family and
+#: the scatter/gather family — one representative of each chained shape)
+DEFAULT_OVERLAP_STRATEGIES = ("psum_bucket", "zero1")
+
+
+def audit_overlap_schedule(strategy: str, n_data: int = 2) -> dict:
+    """Prove the ``exch_overlap`` schedule in the optimized HLO.
+
+    Compiles the step twice at :data:`OVERLAP_AUDIT_BUCKET_MB` — fused
+    and overlapped — and checks, on the operand graph:
+
+    - the overlapped module carries a same-kind collective dependency
+      chain of >= n_buckets - 1 edges, and the chain passes through
+      backward fusions (``interleaved_pairs``) — collectives issue
+      *during* backward, not after it;
+    - the fused module still audits as trailing (ZERO same-kind edges) —
+      the negative proof that the discriminator measures the transform,
+      not scheduler noise;
+    - overlap changes the SCHEDULE only: per-kind collective counts are
+      identical to the fused module, and donation is intact.
+    """
+    fused = _train_artifact(strategy, n_data,
+                            bucket_mb=OVERLAP_AUDIT_BUCKET_MB)
+    over = _train_artifact(strategy, n_data, overlap=True,
+                           bucket_mb=OVERLAP_AUDIT_BUCKET_MB)
+    violations: list[str] = []
+    n_buckets = over["n_buckets"] or 0
+    if n_buckets < 2:
+        violations.append(
+            f"overlap artifact packed only {n_buckets} grad bucket(s) at "
+            f"{OVERLAP_AUDIT_BUCKET_MB} MiB — nothing to chain; shrink "
+            f"OVERLAP_AUDIT_BUCKET_MB")
+    need = max(1, n_buckets - 1)
+    if over["chain"]["chained_same_kind"] < need:
+        violations.append(
+            f"overlap ON but only {over['chain']['chained_same_kind']} "
+            f"collective chain edges < {need} (buckets={n_buckets}) — the "
+            f"fence chain was optimized away; collectives can sink behind "
+            f"backward again")
+    if over["chain"]["interleaved_pairs"] < need:
+        violations.append(
+            f"overlap chain exists but only "
+            f"{over['chain']['interleaved_pairs']} chained pairs run "
+            f"through backward fusions < {need} — comm is chained but not "
+            f"interleaved with compute")
+    if fused["chain"]["chained_same_kind"] != 0:
+        violations.append(
+            f"fused baseline shows {fused['chain']['chained_same_kind']} "
+            f"same-kind collective chain edges (expected 0: trailing / "
+            f"unconstrained) — the discriminator no longer isolates the "
+            f"overlap transform")
+    if over["collectives"] != fused["collectives"]:
+        violations.append(
+            f"overlap changed collective counts: {over['collectives']} != "
+            f"fused {fused['collectives']} — the fence must reorder, never "
+            f"add or split collectives")
+    if over["alias_count"] < over["n_param_leaves"]:
+        violations.append(
+            f"donation not applied under overlap: {over['alias_count']} "
+            f"aliased buffers < {over['n_param_leaves']} param leaves")
+    if over["host_callbacks"]:
+        violations.append(
+            f"host callbacks in the overlapped step: "
+            f"{over['host_callbacks']}")
+    return {"kind": "train-overlap", "strategy": strategy, "n_data": n_data,
+            "n_buckets": n_buckets, "ok": not violations,
+            "violations": violations,
+            "chain": over["chain"], "fused_chain": fused["chain"],
+            "collectives": over["collectives"],
+            "alias_count": over["alias_count"]}
 
 
 # -- representative serve step ----------------------------------------------
@@ -242,7 +435,8 @@ def audit_serve_step() -> dict:
 # -- entry point -------------------------------------------------------------
 
 #: what ``tmlint --hlo-audit`` (and the tier-1 test) audits: the two
-#: strategies the acceptance criteria name, plus the serve decode step
+#: strategies the acceptance criteria name, their overlapped-schedule
+#: locks (ISSUE 12 — the BASELINE step-7 gate), plus the serve decode step
 DEFAULT_TRAIN_STRATEGIES = ("psum_bucket", "zero1")
 
 
@@ -271,6 +465,12 @@ def run_default_audits(n_data: int = 4) -> list[dict]:
             f"--xla_force_host_platform_device_count={n_data} "
             f"before jax initializes")
     reports = [audit_train_step(s, n_data) for s in DEFAULT_TRAIN_STRATEGIES]
+    # the overlap audits run at n_data=2 (the signature default, shared
+    # with the test suite's lru entries): the chain/interleave facts are
+    # device-count-independent and the fused-vs-overlapped comparison is
+    # at matched n, so extra devices only add compile time
+    reports += [audit_overlap_schedule(s)
+                for s in DEFAULT_OVERLAP_STRATEGIES]
     reports.append(audit_serve_step())
     bad = [r for r in reports if not r["ok"]]
     if bad:

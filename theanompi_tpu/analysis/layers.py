@@ -64,7 +64,8 @@ LAYER_DAG: tuple[tuple[str, tuple[str, ...], tuple[str, ...]], ...] = (
     ("utils_base", (f"{PKG}.utils.helper_funcs", f"{PKG}.utils.recorder",
                     f"{PKG}.utils.divergence"),
                    ("mesh",)),
-    ("exchange",   (f"{PKG}.parallel.exchanger",), ("mesh", "kernels")),
+    ("exchange",   (f"{PKG}.parallel.exchanger", f"{PKG}.parallel.overlap"),
+                   ("mesh", "kernels")),
     ("data",       (f"{PKG}.models.data",),
                    ("codes", "resilience", "utils_base")),
     ("models",     (f"{PKG}.models",),

@@ -80,7 +80,8 @@ def clip_by_global_norm(grads, max_norm: float, param_specs=None):
     return _tmap(lambda g: (g * scale).astype(g.dtype), grads)
 
 
-def sharded_update(opt, grads, opt_state, params, lr, axis_name=None):
+def sharded_update(opt, grads, opt_state, params, lr, axis_name=None,
+                   chain=None):
     """ZeRO-1 shard-local optimizer update (the exchanger's ``zero1`` entry
     point): same math as ``opt.update`` on the full tree, applied to the
     1/n shard each device owns of the flattened bucket buffers.
@@ -92,6 +93,23 @@ def sharded_update(opt, grads, opt_state, params, lr, axis_name=None):
     psum of per-shard squared norms over ``axis_name`` IS the global norm.
     Clipping is applied here and then disabled on the inner optimizer so it
     is never double-applied.
+
+    ``chain`` (overlapped exchange only) is ``(order, fence)``: ``order``
+    lists bucket indices in scatter-arrival order and ``fence(buf, prev)``
+    is the value-preserving dependency fence from
+    :mod:`theanompi_tpu.parallel.overlap`.  Each *updated* shard is
+    fenced on the previous arrival's updated shard, so buckets are
+    released to the downstream all-gathers in arrival order — the
+    shard-local updates consume buckets as they arrive instead of
+    floating free of the collective schedule.  The fence sits on the
+    OUTPUTS, never the update's inputs: because every update rule here is
+    elementwise over the bucket list, bucket k's update already depends
+    on nothing but its own scattered grads (arrival-ordered upstream by
+    the exchanger), and fencing the inputs would reorganize the update's
+    fusion clusters — different FMA contractions, a one-ulp drift, and a
+    broken fused-vs-overlapped bit-equality lock (tests/test_overlap.py).
+    With ``grad_clip`` set, the global-norm psum is an inherent
+    all-bucket sync point; the chain still pins the release order.
     """
     if opt.grad_clip:
         sq = global_sq_norm(grads)
@@ -103,7 +121,16 @@ def sharded_update(opt, grads, opt_state, params, lr, axis_name=None):
         scale = jnp.minimum(1.0, opt.grad_clip / jnp.maximum(norm, 1e-12))
         grads = _tmap(lambda g: (g * scale).astype(g.dtype), grads)
         opt = dataclasses.replace(opt, grad_clip=None)
-    return opt.update(grads, opt_state, params, lr)
+    new_params, new_opt_state = opt.update(grads, opt_state, params, lr)
+    if chain is not None:
+        order, fence = chain
+        new_params = list(new_params)
+        prev = None
+        for i in order:
+            if prev is not None:
+                new_params[i] = fence(new_params[i], prev)
+            prev = new_params[i]
+    return new_params, new_opt_state
 
 
 class Optimizer:

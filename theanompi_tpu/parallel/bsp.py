@@ -20,7 +20,7 @@ from __future__ import annotations
 import jax
 from jax.sharding import PartitionSpec as P
 
-from theanompi_tpu.parallel.exchanger import Exchanger
+from theanompi_tpu.parallel.exchanger import BUCKETED_STRATEGIES, Exchanger
 from theanompi_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -28,6 +28,7 @@ from theanompi_tpu.parallel.mesh import (
     SEQ_AXIS,
     shard_map,
 )
+from theanompi_tpu.parallel.overlap import RampSchedule
 from theanompi_tpu.parallel.trainer import (
     BaseTrainer,
     Rule,
@@ -46,16 +47,40 @@ class BSPTrainer(BaseTrainer):
     """
 
     def __init__(self, model, mesh=None, exch_strategy: str = "psum",
-                 exch_bucket_mb: float = 4.0, **kwargs):
+                 exch_bucket_mb: float = 4.0, exch_overlap: bool = False,
+                 exch_ramp: str | None = None, **kwargs):
         super().__init__(model, mesh=mesh, **kwargs)
         # reduce over every axis the batch is sharded on (data; +seq for
         # sequence-parallel models whose grads are per-shard partials);
         # exch_bucket_mb caps the fused-bucket payload of the *_bucket /
-        # ring_int8 / zero1 strategies (see exchanger module docstring)
-        self.exchanger = Exchanger(
-            strategy=exch_strategy, axis_name=model.grad_reduce_axes(),
-            bucket_bytes=int(float(exch_bucket_mb) * 2**20),
-        )
+        # ring_int8 / zero1 strategies (see exchanger module docstring);
+        # exch_overlap chains per-bucket collectives into backward and
+        # exch_ramp schedules coarse->exact wire phases over epochs
+        # (both in theanompi_tpu/parallel/overlap.py)
+        self.exch_strategy_base = exch_strategy
+        self.exch_overlap = bool(exch_overlap)
+        self.ramp = (RampSchedule.parse(exch_ramp, exch_strategy)
+                     if exch_ramp else None)
+        axis_name = model.grad_reduce_axes()
+        bucket_bytes = int(float(exch_bucket_mb) * 2**20)
+
+        def build_exchanger(strategy, overlap):
+            return Exchanger(strategy=strategy, axis_name=axis_name,
+                             bucket_bytes=bucket_bytes, overlap=overlap)
+
+        # every ramp phase's exchanger is built (and therefore validated
+        # against the mesh axes) eagerly, so a bad phase fails at trainer
+        # construction, not at its epoch boundary mid-run.  Overlap applies
+        # to every bucketed phase; a leaf-wise ramp phase has no buckets to
+        # chain and runs unchained.  The base strategy must be bucketed for
+        # exch_overlap (the Exchanger raises a clear error otherwise).
+        self._ramp_exchangers = {
+            s: build_exchanger(s, self.exch_overlap
+                               and s in BUCKETED_STRATEGIES)
+            for s in (self.ramp.strategies if self.ramp else ())
+        }
+        self.exchanger = self._ramp_exchangers.get(
+            exch_strategy) or build_exchanger(exch_strategy, self.exch_overlap)
         if self.checkpointer is not None:
             # ISSUE 8: the elastic reshard planner must recompute the
             # zero1 bucket layout with the exchanger's exact bucket size
@@ -98,6 +123,23 @@ class BSPTrainer(BaseTrainer):
     # -- compilation ---------------------------------------------------------
     def compile_iter_fns(self) -> None:
         """Build + jit the train/eval steps (reference method name)."""
+        self._build_step_fn()
+        local_eval = make_local_eval(self.model, axes=self.exchanger.axis_name)
+        pspecs, sspecs, _ = self._spec_trees()
+        self._eval_fn = jax.jit(
+            shard_map(
+                local_eval,
+                self.mesh,
+                in_specs=(pspecs, sspecs, self.batch_spec),
+                out_specs=P(),
+            )
+        )
+
+    def _build_step_fn(self) -> None:
+        """(Re)build the jitted train step around the ACTIVE exchanger —
+        split out of :meth:`compile_iter_fns` so an ``exch_ramp`` phase
+        switch rebuilds only the step (the eval fn doesn't touch the
+        exchange and would recompile for nothing)."""
         pspecs, sspecs, ospecs = self._spec_trees()
         sentinel_skip = self.sentinel is not None and self.sentinel.device_guard
         if sentinel_skip:
@@ -121,27 +163,69 @@ class BSPTrainer(BaseTrainer):
             exchanger=self.exchanger, param_specs=pspecs,
             sentinel_skip=sentinel_skip,
         )
-        local_eval = make_local_eval(self.model, axes=self.exchanger.axis_name)
 
-        self._step_fn = jax.jit(
-            shard_map(
-                local_step,
-                self.mesh,
-                in_specs=(pspecs, sspecs, ospecs, self.batch_spec, P(), P()),
-                out_specs=(pspecs, sspecs, ospecs, P()),
-            ),
-            # 5 is the device step counter: donated so the returned
-            # `_next_step` scalar aliases it (trainer scalar-hoisting)
-            donate_argnums=(0, 1, 2, 5),
-        )
-        self._eval_fn = jax.jit(
-            shard_map(
-                local_eval,
-                self.mesh,
-                in_specs=(pspecs, sspecs, self.batch_spec),
-                out_specs=P(),
+        from contextlib import nullcontext
+        span = (self.telemetry.span("exchange.overlap",
+                                    strategy=self.exchanger.strategy)
+                if self.telemetry is not None and self.exchanger.overlap
+                else nullcontext())
+        with span:
+            # the span marks (re)arming of the chained step — the overlap
+            # itself is inside the compiled program and host-invisible
+            self._step_fn = jax.jit(
+                shard_map(
+                    local_step,
+                    self.mesh,
+                    in_specs=(pspecs, sspecs, ospecs, self.batch_spec,
+                              P(), P()),
+                    out_specs=(pspecs, sspecs, ospecs, P()),
+                ),
+                # 5 is the device step counter: donated so the returned
+                # `_next_step` scalar aliases it (trainer scalar-hoisting)
+                donate_argnums=(0, 1, 2, 5),
             )
-        )
+
+    # -- quantization ramp (exch_ramp) ---------------------------------------
+    def _maybe_ramp(self, epoch: int) -> None:
+        """Activate the ramp phase ``epoch`` dictates (epoch-boundary hook).
+
+        A switch swaps in the phase's pre-validated exchanger, rebuilds
+        ONLY the step fn (one fenced recompile per phase — jit compiles
+        lazily, so phases that never run never compile), and invalidates
+        the wire-byte cache so telemetry's ``exchange.accounting`` instant
+        re-emits with the phase's strategy/bytes.  The phase is a pure
+        function of the absolute epoch, so ``try_resume`` -> ``_run_epochs``
+        lands a mid-ramp restart in the right phase with no extra state.
+        """
+        if self.ramp is None:
+            return
+        want = self.ramp.strategy_for_epoch(epoch)
+        if want == self.exchanger.strategy:
+            return
+        self.exchanger = self._ramp_exchangers[want]
+        self._build_step_fn()
+        self._compiled_step_cache = None
+        self._exchange_wire_bytes_cached = None
+        if self.telemetry is not None:
+            phase = self.ramp.phase_for_epoch(epoch)
+            self.telemetry.gauge("exchange.ramp_phase", phase, epoch=epoch)
+            self.telemetry.instant("exchange.ramp_switch", epoch=epoch,
+                                   strategy=want, phase=phase)
+
+    def _fingerprint_extra(self) -> dict:
+        """Ramp-proof the run fingerprint: stamp the BASE strategy (the
+        active exchanger varies by epoch under a ramp, and a resume
+        compares fingerprints before the first ``_maybe_ramp``), plus the
+        ramp/overlap knobs themselves when set — changing either across a
+        resume is a real topology change (different wire numerics /
+        schedule) and should hit the ``resume_force`` gate."""
+        extra = {}
+        if self.ramp is not None:
+            extra["exchange"] = self.exch_strategy_base
+            extra["exch_ramp"] = self.ramp.describe()
+        if self.exch_overlap:
+            extra["exch_overlap"] = True
+        return extra
 
     def init_state(self) -> None:
         params, state = self.model.init_params(jax.random.PRNGKey(self.seed + 1))
@@ -171,5 +255,7 @@ class BSP(Rule):
             mesh=mesh,
             exch_strategy=self.config.get("exch_strategy", "psum"),
             exch_bucket_mb=self.config.get("exch_bucket_mb", 4.0),
+            exch_overlap=bool(self.config.get("exch_overlap", False)),
+            exch_ramp=self.config.get("exch_ramp") or None,
             **self.common_trainer_kwargs(recorder),
         )

@@ -34,6 +34,10 @@ into a small number of fixed-size fused buckets (default ~4 MiB, leaves
 grouped by dtype, greedy fill — an oversized leaf gets its own bucket) before
 the collective and unpack after, so a 100+-leaf model compiles to a handful
 of ``all-reduce`` HLO ops (lint-tested in ``tests/test_lint_collectives.py``).
+With ``overlap=True`` (the ``exch_overlap`` rule key) the bucketed strategies
+additionally chain the per-bucket collectives in reverse layout order so they
+issue *during* backward instead of trailing it — mechanism, bit-equality
+contract, and audit story in :mod:`theanompi_tpu.parallel.overlap`.
 
 - ``psum_bucket``/``psum_bf16_bucket`` — fused-bucket analogues of
   ``psum``/``psum_bf16`` (multi-axis capable, like their leaf-wise twins).
@@ -68,6 +72,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from theanompi_tpu.parallel.mesh import DATA_AXIS
+from theanompi_tpu.parallel.overlap import fence as _fence
+from theanompi_tpu.parallel.overlap import overlap_pred as _overlap_pred
 
 # strategy name -> fn(x, axis_name, axis_size) -> mean-reduced x (leaf-wise)
 STRATEGIES: dict[str, Callable] = {}
@@ -413,7 +419,8 @@ class Exchanger:
 
     def __init__(self, strategy: str = "psum",
                  axis_name: str | tuple[str, ...] = DATA_AXIS,
-                 bucket_bytes: int = DEFAULT_BUCKET_BYTES):
+                 bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                 overlap: bool = False):
         known = set(STRATEGIES) | set(BUCKETED_STRATEGIES)
         if strategy not in known:
             raise ValueError(
@@ -432,9 +439,15 @@ class Exchanger:
             axis_name = axis_name[0]
         if int(bucket_bytes) < 1:
             raise ValueError(f"bucket_bytes must be positive, got {bucket_bytes}")
+        if overlap and strategy not in BUCKETED_STRATEGIES:
+            raise ValueError(
+                f"exch_overlap chains per-bucket collectives; strategy "
+                f"{strategy!r} is not bucketed (one of {BUCKETED_STRATEGIES})"
+            )
         self.strategy = strategy
         self.axis_name = axis_name
         self.bucket_bytes = int(bucket_bytes)
+        self.overlap = bool(overlap)
         self._fn = STRATEGIES.get(strategy)
 
     # -- properties ----------------------------------------------------------
@@ -465,8 +478,18 @@ class Exchanger:
                 f"binding axes {self._axes()!r}"
             ) from e
 
+    def _chain_pred(self, step):
+        """The fence predicate for the overlap chain, from the traced step
+        scalar — see :mod:`theanompi_tpu.parallel.overlap`."""
+        if step is None:
+            raise ValueError(
+                "exch_overlap needs the traced int32 step scalar to anchor "
+                "the fence chain; pass step= to exchange()/exchange_and_update()"
+            )
+        return _overlap_pred(step)
+
     # -- exchange ------------------------------------------------------------
-    def exchange(self, tree, rng=None):
+    def exchange(self, tree, rng=None, step=None):
         """Mean-reduce every floating leaf across the exchange axes.
 
         Call inside ``shard_map`` over a mesh that binds ``axis_name``
@@ -479,6 +502,15 @@ class Exchanger:
         ``rng`` seeds ``ring_int8``'s stochastic rounding (ignored by every
         other strategy); pass a fresh per-step key so the rounding noise
         decorrelates across steps — ``None`` falls back to a fixed key.
+
+        ``step`` (the traced int32 step scalar) is required when
+        ``overlap`` is on: buckets are walked in reverse layout order and
+        each bucket's buffer is fenced on the previous bucket's reduction
+        (see :mod:`theanompi_tpu.parallel.overlap`), so collectives issue
+        during backward instead of trailing it.  The per-bucket rng fold
+        uses the bucket *index*, not the walk order, so ``ring_int8``'s
+        rounding noise — and therefore the result — is identical to the
+        fused walk.
         """
         if self.fuses_update:
             raise ValueError(
@@ -499,12 +531,24 @@ class Exchanger:
 
         leaves, treedef = jax.tree_util.tree_flatten(tree)
         out = list(leaves)
-        for bi, bucket in enumerate(_bucket_layout(leaves, self.bucket_bytes, n)):
+        buckets = _bucket_layout(leaves, self.bucket_bytes, n)
+        order = range(len(buckets))
+        pred, prev = None, None
+        if self.overlap:
+            pred = self._chain_pred(step)
+            order = reversed(order)
+        for bi in order:
+            bucket = buckets[bi]
             key = None
             if self.strategy == "ring_int8":
                 base = rng if rng is not None else jax.random.PRNGKey(0)
                 key = jax.random.fold_in(base, bi)
-            red = self._reduce_bucket(_pack(leaves, bucket), n, key)
+            buf = _pack(leaves, bucket)
+            if prev is not None:
+                buf = _fence(buf, prev, pred)
+            red = self._reduce_bucket(buf, n, key)
+            if self.overlap:
+                prev = red
             for i, arr in _unpack(red, bucket).items():
                 out[i] = arr
         return jax.tree_util.tree_unflatten(treedef, out)
@@ -528,7 +572,8 @@ class Exchanger:
         raise AssertionError(f"not a bucketed reduce strategy: {s}")
 
     # -- zero1: fused exchange + sharded optimizer update --------------------
-    def exchange_and_update(self, grads, opt_state, params, lr, opt, rng=None):
+    def exchange_and_update(self, grads, opt_state, params, lr, opt, rng=None,
+                            step=None):
         """ZeRO-1 step: reduce-scatter grad buckets (mean), update the local
         1/n shard of params with the (sharded) ``opt_state``, all-gather the
         updated params.  -> (new_params, new_opt_state).
@@ -539,6 +584,16 @@ class Exchanger:
         shard.  Non-inexact param leaves pass through un-updated (same
         skip as ``exchange``; float params are the contract).  ``rng`` is
         accepted for signature parity with ``exchange`` and unused.
+
+        With ``overlap`` on (``step`` required), all three stages are
+        chained in reverse layout order — the backward-readiness order:
+        each bucket's packed grads are fenced on the previous bucket's
+        scatter result (reduce-scatters issue during backward), the
+        shard-local updates consume scattered buckets as they arrive
+        (``chain=`` on :func:`theanompi_tpu.ops.opt.sharded_update`), and
+        each all-gather is fenced on the previous gather (gathers issue
+        as their bucket's update lands).  All fences are value-preserving,
+        so the result is bit-identical to the unfenced schedule.
         """
         from theanompi_tpu.ops.opt import sharded_update
 
@@ -548,23 +603,46 @@ class Exchanger:
         g_leaves = jax.tree_util.tree_flatten(grads)[0]
         buckets = _bucket_layout(p_leaves, self.bucket_bytes, n)
         idx = lax.axis_index(axis) if n > 1 else 0
-        g_shards, p_shards = [], []
-        for bucket in buckets:
+        overlap = self.overlap and n > 1
+        order = list(range(len(buckets)))
+        pred, chain = None, None
+        if overlap:
+            pred = self._chain_pred(step)
+            order = order[::-1]
+            chain = (order, lambda buf, prev: _fence(buf, prev, pred))
+        g_shards: list = [None] * len(buckets)
+        p_shards: list = [None] * len(buckets)
+        prev = None
+        for bi in order:
+            bucket = buckets[bi]
             g = _pack(g_leaves, bucket)
             p = _pack(p_leaves, bucket)
             if n > 1:
+                if prev is not None:
+                    g = _fence(g, prev, pred)
                 g = lax.psum_scatter(g.reshape(n, -1), axis,
                                      scatter_dimension=0, tiled=False) / n
                 p = lax.dynamic_index_in_dim(p.reshape(n, -1), idx, 0,
                                              keepdims=False)
-            g_shards.append(g)
-            p_shards.append(p)
+            if overlap:
+                prev = g
+            g_shards[bi] = g
+            p_shards[bi] = p
         new_shards, new_opt_state = sharded_update(
-            opt, g_shards, opt_state, p_shards, lr, axis_name=axis)
+            opt, g_shards, opt_state, p_shards, lr, axis_name=axis,
+            chain=chain)
         out = list(p_leaves)
-        for bucket, shard in zip(buckets, new_shards):
-            full = (lax.all_gather(shard, axis, axis=0, tiled=True)
-                    if n > 1 else shard)
+        prev = None
+        for bi in order:
+            bucket, shard = buckets[bi], new_shards[bi]
+            if n > 1:
+                if prev is not None:
+                    shard = _fence(shard, prev, pred)
+                full = lax.all_gather(shard, axis, axis=0, tiled=True)
+            else:
+                full = shard
+            if overlap:
+                prev = full
             for i, arr in _unpack(full, bucket).items():
                 out[i] = arr
         return jax.tree_util.tree_unflatten(treedef, out), new_opt_state
@@ -632,4 +710,6 @@ class Exchanger:
         }
 
     def __repr__(self):
-        return f"Exchanger(strategy={self.strategy!r}, axis={self.axis_name!r})"
+        extra = ", overlap=True" if self.overlap else ""
+        return (f"Exchanger(strategy={self.strategy!r}, "
+                f"axis={self.axis_name!r}{extra})")

@@ -1,0 +1,173 @@
+"""Comm/compute overlap for the bucketed exchange + the quantization ramp.
+
+Overlap (the ``exch_overlap`` rule key)
+---------------------------------------
+
+The bucketed strategies pack gradients into a handful of fused flat
+buffers and issue one collective per bucket.  With the stock schedule the
+buckets are mutually independent dataflow, so XLA is free to sink every
+collective to the end of the step — all of backward runs, THEN all
+all-reduces fire back-to-back, serializing comm after compute exactly
+like the pre-bucketing leaf-wise path did.
+
+``exch_overlap=True`` pins the *issue order* instead: buckets are walked
+in REVERSE layout order (backward produces the last layers' gradients
+first — the same readiness heuristic as PyTorch DDP's bucket ordering)
+and each bucket's packed buffer is given a data dependency on the
+previous bucket's reduction result via :func:`fence`.  The chain means
+collective k+1 cannot be scheduled before collective k has issued, so
+the scheduler interleaves bucket k's collective with the backward
+fusions that produce bucket k+1 — comm rides under compute instead of
+trailing it.
+
+Why a ``select`` fence and not ``lax.optimization_barrier``: on the CPU
+backend the barrier survives lowering to StableHLO but is *stripped* by
+the XLA optimization pipeline — it leaves no ordering constraint and no
+auditable trace in the optimized module.  The select fence below is real
+dataflow: it survives every pass on every backend, and the resulting
+collective→collective dependency edges are exactly what
+``analysis/hlo_audit.py`` measures to prove the schedule
+(:func:`theanompi_tpu.analysis.hlo_audit.audit_overlap_schedule`).
+
+Bit-equality contract: the fence's predicate is true at runtime, so
+``select`` returns the bucket buffer verbatim — the overlapped path
+produces bit-identical parameters to the fused path (locked in
+``tests/test_overlap.py``).  The predicate must be *opaque* to the
+compiler: ``step >= 0`` on the traced int32 step scalar works because
+XLA cannot prove a signed runtime parameter non-negative, while a
+constant-true predicate (or ``x - x`` / ``0 * probe`` style no-ops)
+would be folded away and dissolve the chain.
+
+Quantization ramp (the ``exch_ramp`` rule key)
+----------------------------------------------
+
+Early training tolerates coarse gradients; late training does not.
+:class:`RampSchedule` parses a spec like ``"ring_int8:5,psum_bf16_bucket:10"``
+— int8 wire for epochs [0, 5), bf16 for [5, 10), then the base strategy —
+and the trainer swaps the exchanger at *epoch boundaries only* (one
+fenced recompile per phase, never a per-step recompile storm).  Resume
+derives the active phase from the restored absolute epoch, so a mid-ramp
+checkpoint restarts in the right phase with no extra state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def overlap_pred(step):
+    """The opaque always-true predicate anchoring the fence chain.
+
+    ``step`` is the traced int32 step scalar threaded through the train
+    step.  ``step >= 0`` holds at runtime but is not provable at compile
+    time for a signed parameter (``step >= INT32_MIN`` *would* be folded),
+    so the fence's false branch — and with it the dependency edge —
+    survives optimization.
+    """
+    return step >= jnp.int32(0)
+
+
+def fence(buf, prev, pred):
+    """Give ``buf`` a value-preserving data dependency on ``prev``.
+
+    ``pred`` is always true at runtime (see :func:`overlap_pred`), so the
+    select returns ``buf`` bit-exactly; the false branch folds one element
+    of ``prev`` in, which is what makes ``buf`` depend on ``prev`` in the
+    optimized HLO.  Cost: one fused select+add per bucket — noise next to
+    the collective it orders.
+    """
+    probe = lax.slice_in_dim(prev.reshape(-1), 0, 1)[0]
+    return lax.select(jnp.broadcast_to(pred, buf.shape),
+                      buf, buf + probe.astype(buf.dtype))
+
+
+@dataclasses.dataclass(frozen=True)
+class RampSchedule:
+    """Epoch-indexed exchange-strategy phases parsed from ``exch_ramp``.
+
+    ``phases`` is ``((strategy, until_epoch), ...)`` — each phase is
+    active for epochs ``< until_epoch`` — followed by the base strategy
+    for every remaining epoch (``until_epoch`` None).  Boundaries are
+    strictly increasing; the phase for an epoch is a pure function of the
+    absolute epoch number, which is what makes checkpoint resume restore
+    the right phase for free.
+    """
+
+    phases: tuple  # ((strategy, until_epoch | None), ...); last is the base
+
+    @classmethod
+    def parse(cls, spec: str, base_strategy: str) -> "RampSchedule":
+        """Parse ``"strategy:until_epoch,..."`` (e.g. ``"ring_int8:5"``).
+
+        ``zero1`` is refused anywhere in a ramp — its optimizer state
+        lives in the exchanger's sharded bucket layout, so swapping into
+        or out of it mid-run would require re-laying-out opt state.
+        """
+        from theanompi_tpu.parallel.exchanger import (
+            BUCKETED_STRATEGIES, STRATEGIES)
+
+        known = set(STRATEGIES) | set(BUCKETED_STRATEGIES)
+        phases = []
+        last_until = 0
+        for part in str(spec).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" not in part:
+                raise ValueError(
+                    f"exch_ramp phase {part!r} must be 'strategy:until_epoch'")
+            name, until_s = part.rsplit(":", 1)
+            name = name.strip()
+            try:
+                until = int(until_s)
+            except ValueError:
+                raise ValueError(
+                    f"exch_ramp boundary {until_s!r} is not an epoch number")
+            if name not in known:
+                raise ValueError(
+                    f"unknown exch_ramp strategy {name!r}; "
+                    f"available: {sorted(known)}")
+            if until <= last_until:
+                raise ValueError(
+                    f"exch_ramp boundaries must be strictly increasing; "
+                    f"got {until} after {last_until}")
+            phases.append((name, until))
+            last_until = until
+        if not phases:
+            raise ValueError(f"empty exch_ramp spec {spec!r}")
+        for name, _ in phases + [(base_strategy, None)]:
+            if name == "zero1":
+                raise ValueError(
+                    "zero1 cannot participate in an exch_ramp: its optimizer "
+                    "state is laid out in the exchanger's sharded buckets and "
+                    "cannot be re-laid-out at a phase boundary")
+        phases.append((base_strategy, None))
+        return cls(phases=tuple(phases))
+
+    @property
+    def strategies(self) -> tuple:
+        """Every strategy the ramp can activate, in phase order."""
+        seen, out = set(), []
+        for name, _ in self.phases:
+            if name not in seen:
+                seen.add(name)
+                out.append(name)
+        return tuple(out)
+
+    def phase_for_epoch(self, epoch: int) -> int:
+        for i, (_, until) in enumerate(self.phases):
+            if until is None or epoch < until:
+                return i
+        return len(self.phases) - 1
+
+    def strategy_for_epoch(self, epoch: int) -> str:
+        return self.phases[self.phase_for_epoch(epoch)][0]
+
+    def describe(self) -> str:
+        """Stable string for the run fingerprint."""
+        return ",".join(
+            name if until is None else f"{name}:{until}"
+            for name, until in self.phases)

@@ -174,13 +174,16 @@ def make_local_step(model, opt, base_key, exchanger=None, stacked=False,
                 new_params, new_opt_state = exchanger.exchange_and_update(
                     grads, opt_state, params, lr, opt,
                     rng=jax.random.fold_in(rng, _EXCH_RNG_TAG),
+                    step=step,
                 )
             else:
                 if exchanger is not None:
                     # a distinct stream from dropout's: ring_int8 seeds its
-                    # stochastic rounding from this key
+                    # stochastic rounding from this key.  step anchors the
+                    # overlap fence chain (exch_overlap; unused otherwise)
                     grads = exchanger.exchange(
-                        grads, rng=jax.random.fold_in(rng, _EXCH_RNG_TAG))
+                        grads, rng=jax.random.fold_in(rng, _EXCH_RNG_TAG),
+                        step=step)
                 new_params, new_opt_state = opt.update(
                     grads, opt_state, params, lr, param_specs=param_specs
                 )
@@ -577,7 +580,20 @@ class BaseTrainer:
             "exchange": getattr(exch, "strategy", type(self).__name__),
             "n_subb": int(self.model.config.get("n_subb", 1) or 1),
             **model_fingerprint(self.model),
+            **self._fingerprint_extra(),
         }
+
+    def _fingerprint_extra(self) -> dict:
+        """Subclass hook for extra (or overriding) fingerprint entries —
+        BSP uses it to stamp the ramp-invariant base exchange strategy
+        plus the ``exch_ramp``/``exch_overlap`` knobs, so a checkpoint
+        written mid-ramp still matches a resume that starts at the base."""
+        return {}
+
+    def _maybe_ramp(self, epoch: int) -> None:
+        """Subclass hook, called at the top of every epoch: activate the
+        ``exch_ramp`` phase ``epoch`` dictates (no-op without a ramp).
+        See :class:`theanompi_tpu.parallel.overlap.RampSchedule`."""
 
     def _data_state(self, epoch: int, completed: bool) -> dict:
         """The data-plane position a checkpoint captures (ISSUE 10).
@@ -1108,6 +1124,10 @@ class BaseTrainer:
         try:
             for epoch in range(self.epoch, model.n_epochs):
                 self.epoch = epoch
+                # quantization ramp (exch_ramp): the ONE place a phase can
+                # switch — an epoch boundary, so at most one recompile per
+                # phase and a resume lands in the phase its epoch dictates
+                self._maybe_ramp(epoch)
                 start_batch = 0
                 rds, self._resume_data_state = self._resume_data_state, None
                 if rds is not None and int(rds.get("epoch", -1)) == epoch:

@@ -100,6 +100,20 @@ DATA_COUNTERS = ("data.retries",)
 FLEET_INSTANTS = ("fleet.schedule", "fleet.preempt", "fleet.resume",
                   "fleet.complete", "fleet.fail")
 
+# -- overlapped-exchange / quantization-ramp names (ISSUE 12) -----------------
+# ``exchange.overlap``: span around (re)arming the chained step fn when
+# ``exch_overlap`` is on (tags: strategy) — the overlap itself runs inside
+# the compiled program, so arming is the only host-observable moment.
+# Emitted through these registered names ONLY (same one-source-of-truth
+# contract as the serving/reshard/data/fleet names above).
+EXCHANGE_SPANS = ("exchange.overlap",)
+#: ``exchange.ramp_phase``: the active ``exch_ramp`` phase index, gauged at
+#: each phase switch (tags: epoch); pairs with the ``exchange.ramp_switch``
+#: instant (tags: epoch, strategy, phase) and a re-emitted
+#: ``exchange.accounting`` instant so wire-byte accounting tracks the phase.
+EXCHANGE_GAUGES = ("exchange.ramp_phase",)
+EXCHANGE_INSTANTS = ("exchange.ramp_switch",)
+
 
 class MetricsRegistry:
     """Named counters (monotonic totals), gauges (last value), histograms

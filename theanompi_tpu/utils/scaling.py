@@ -152,7 +152,7 @@ def measure_comm_share(trainer, batches, steps: int = 6, lr: float = 0.01):
 
 
 def _build(model_name: str, model_config: dict, n: int, strategy: str,
-           bucket_mb: float = 4.0):
+           bucket_mb: float = 4.0, overlap: bool = False):
     import jax
 
     from theanompi_tpu.parallel.bsp import BSPTrainer
@@ -170,7 +170,7 @@ def _build(model_name: str, model_config: dict, n: int, strategy: str,
     model = model_cls(cfg)
     mesh = make_mesh(n_data=n, devices=jax.devices()[:n])
     trainer = BSPTrainer(model, mesh=mesh, exch_strategy=strategy,
-                         exch_bucket_mb=bucket_mb,
+                         exch_bucket_mb=bucket_mb, exch_overlap=overlap,
                          recorder=Recorder(verbose=False, print_freq=10**9))
     trainer.compile_iter_fns()
     trainer.init_state()
@@ -301,6 +301,7 @@ def exchange_microbench(
     steps: int = 4,
     trials: int = 1,
     bucket_mb: float = 4.0,
+    overlap: bool = False,
     out_path: str | None = None,
 ) -> dict:
     """Exchange-strategy microbenchmark on an ``n``-device mesh.
@@ -313,9 +314,19 @@ def exchange_microbench(
     only bound framework overhead; the collective counts and byte
     accounting are exact on any backend — that is the point: bucketing
     regressions show up as op-count jumps with no TPU attached.
+
+    ``overlap=True`` (ISSUE 12) adds the fused-vs-overlapped comparison:
+    every bucketed strategy is built a second time with ``exch_overlap``
+    and a shared ``none``-strategy baseline is measured once, so each row
+    gains ``step_ms_overlap`` plus the differential comm shares
+    ``comm_share_differential`` (fused) and
+    ``comm_share_differential_overlap`` — the overlap claim is precisely
+    that the second number approaches zero (comm hidden under backward)
+    while wire bytes and collective counts stay identical.
     """
     import jax
 
+    from theanompi_tpu.parallel.exchanger import BUCKETED_STRATEGIES
     from theanompi_tpu.telemetry.metrics import hlo_collective_counts
     from theanompi_tpu.utils.benchlib import best_trial
 
@@ -323,14 +334,24 @@ def exchange_microbench(
         "batch_size": 8, "n_train": 64, "n_val": 16,
         "n_epochs": 1, "augment": False, "verbose": False,
     }
-    per_strategy = {}
-    for strategy in strategies:
+
+    def timed(strategy, ov=False):
         trainer, batches = _build(model_name, model_config, n, strategy,
-                                  bucket_mb=bucket_mb)
+                                  bucket_mb=bucket_mb, overlap=ov)
         m = trainer.train_iter(batches[0], lr=0.01)  # compile + warm
         float(m["cost"])
         counts = hlo_collective_counts(trainer.compiled_step_text(batches[0]))
         (dt, _, _), _ = best_trial(trainer, batches, steps, trials)
+        return trainer, counts, dt
+
+    t_base = None
+    if overlap and n > 1:
+        # ONE exchange-free baseline shared by every differential column
+        _, _, t_base = timed("none")
+
+    per_strategy = {}
+    for strategy in strategies:
+        trainer, counts, dt = timed(strategy)
         row = {
             "collectives": counts,
             "collective_ops_total": sum(counts.values()),
@@ -341,6 +362,18 @@ def exchange_microbench(
             trainer._shard_param_structs(), n)
         if buckets:
             row["buckets"] = buckets
+        if t_base is not None:
+            row["comm_share_differential"] = round(
+                max(0.0, 1.0 - t_base / dt), 4)
+        if overlap and strategy in BUCKETED_STRATEGIES:
+            _, counts_ov, dt_ov = timed(strategy, ov=True)
+            row["step_ms_overlap"] = round(dt_ov / steps * 1e3, 3)
+            # the schedule lock rides along: overlap must not change WHAT
+            # is communicated, only WHEN (audited in analysis/hlo_audit)
+            row["overlap_collectives_equal"] = (counts_ov == counts)
+            if t_base is not None:
+                row["comm_share_differential_overlap"] = round(
+                    max(0.0, 1.0 - t_base / dt_ov), 4)
         per_strategy[strategy] = row
     artifact = {
         "model": model_name,
@@ -348,6 +381,7 @@ def exchange_microbench(
         "platform": jax.devices()[0].platform,
         "steps": steps,
         "bucket_mb": bucket_mb,
+        "overlap": bool(overlap),
         "per_strategy": per_strategy,
         "note": ("collective counts + wire bytes are static/exact on any "
                  "backend; step_ms is only meaningful on real chips"),
@@ -387,6 +421,11 @@ def main(argv=None):
                    help="comma list for --exchange-bench")
     p.add_argument("--bucket-mb", type=float, default=4.0,
                    help="fused-bucket size for the bucketed strategies")
+    p.add_argument("--overlap", action="store_true",
+                   help="with --exchange-bench: add the fused-vs-overlapped "
+                   "(exch_overlap) comparison column per bucketed strategy, "
+                   "plus differential comm shares against a shared "
+                   "no-exchange baseline")
     args = p.parse_args(argv)
     if args.virtual:
         from theanompi_tpu.parallel.mesh import force_host_devices
@@ -408,10 +447,12 @@ def main(argv=None):
             args.model, cfg, n=ns[-1],
             strategies=tuple(args.strategies.split(",")),
             steps=args.steps, trials=args.trials,
-            bucket_mb=args.bucket_mb, out_path=out)
+            bucket_mb=args.bucket_mb, overlap=args.overlap, out_path=out)
         for s, r in art["per_strategy"].items():
             c = r["collectives"]
-            print(f"{s:18s} step {r['step_ms']:8.3f} ms  "
+            ov = (f"  ov {r['step_ms_overlap']:8.3f} ms"
+                  if "step_ms_overlap" in r else "")
+            print(f"{s:18s} step {r['step_ms']:8.3f} ms{ov}  "
                   f"wire {r['wire_bytes_per_step']:>12}  "
                   f"ar {c.get('all-reduce', 0):3d}  "
                   f"rs {c.get('reduce-scatter', 0):3d}  "
