@@ -17,11 +17,13 @@ Two kinds of check:
   ``parallel.tensor``) and are exempt from layering.
 - **walls** (ANY-depth imports): the hard boundaries no lazy import may
   cross — serving must never touch training machinery even lazily, and
-  the two bottom layers (telemetry, resilience) must stay leaves so
-  everything above can depend on them without cycles.  ``resilience/
-  codes.py`` staying import-free is what lets both halves of the
-  supervisor share it; the companion ``exit-code`` rule keeps it the
-  only source of exit codes.
+  the bottom layers stay (near-)leaves so everything above can depend
+  on them without cycles: telemetry imports nothing in-package, and
+  resilience reaches only down (codes, telemetry — relaxed in ISSUE 13
+  so the watchdog/sentinel emit through the registered names in
+  ``telemetry/metrics.py``).  ``resilience/codes.py`` staying
+  import-free is what lets both halves of the supervisor share it; the
+  companion ``exit-code`` rule keeps it the only source of exit codes.
 """
 
 from __future__ import annotations
@@ -51,7 +53,7 @@ LAYER_DAG: tuple[tuple[str, tuple[str, ...], tuple[str, ...]], ...] = (
     ("codes",      (f"{PKG}.resilience.codes",), ()),
     ("native",     (f"{PKG}.native",), ()),
     ("telemetry",  (f"{PKG}.telemetry",), ()),
-    ("resilience", (f"{PKG}.resilience",), ("codes",)),
+    ("resilience", (f"{PKG}.resilience",), ("codes", "telemetry")),
     ("mesh",       (f"{PKG}.parallel.mesh",), ()),
     ("kernels",    (f"{PKG}.ops.initializers", f"{PKG}.ops.layers",
                     f"{PKG}.ops.losses", f"{PKG}.ops.quant",
@@ -137,7 +139,10 @@ FLEET_FORBIDDEN_IMPORTS = (
 #: (and telemetry in particular must stay importable before jax init)
 LEAF_SUBPACKAGES = {
     f"{PKG}.telemetry": (f"{PKG}.telemetry",),
-    f"{PKG}.resilience": (f"{PKG}.resilience",),
+    # resilience may reach telemetry (ISSUE 13: registered event names +
+    # the watchdog's flight-recorder dump) — still downward-only, so the
+    # no-cycles property holds: telemetry itself stays a strict leaf
+    f"{PKG}.resilience": (f"{PKG}.resilience", f"{PKG}.telemetry"),
     f"{PKG}.native": (f"{PKG}.native",),
 }
 

@@ -22,6 +22,10 @@ incident history:
 - ``data-determinism`` — ISSUE 10's resume contract: one unseeded
   ``np.random.*`` draw in ``models/data/`` makes batch content depend on
   call order, which a mid-epoch cursor fast-forward cannot reproduce.
+- ``telemetry-registered-names`` — ISSUE 13's health detectors and the
+  fleet aggregator key on event names; a string-literal name at an
+  emission site in ``serving/``/``resilience/`` is a typo'd or drifted
+  name the registry in ``telemetry/metrics.py`` cannot catch.
 
 Every rule is heuristic where it must be (static analysis cannot prove a
 buffer is donated); the escape hatch is the suppression grammar in
@@ -707,3 +711,66 @@ class DataDeterminismRule(Rule):
                     f"pure function of (seed, epoch, position) or mid-epoch "
                     f"resume diverges; use np.random.RandomState("
                     f"derive_seed(...)) instead")
+
+
+# ---------------------------------------------------------------------------
+# telemetry event-name registration
+# ---------------------------------------------------------------------------
+
+#: trees whose emission sites must use the registered-name tuples from
+#: ``telemetry/metrics.py`` (ISSUE 13): the health detectors, tmhealth and
+#: the fleet aggregator all key on event names, so a typo'd literal at an
+#: emission site silently drops the event from every consumer.  Training
+#: code is exempt for now — its names predate the registry.
+REGISTERED_NAME_PREFIXES = (
+    "theanompi_tpu/serving/",
+    "theanompi_tpu/resilience/",
+)
+
+#: emission entry points whose FIRST positional argument is an event name
+#: (``Telemetry.span/instant/emit_span/observe/gauge/count`` plus the
+#: ``self._emit(name, **fields)`` wrappers in the scheduler and sentinel)
+_EMIT_NAME_ATTRS = {"span", "instant", "emit_span", "observe", "gauge",
+                    "count", "_emit"}
+
+
+@register
+class TelemetryRegisteredNamesRule(Rule):
+    """String-literal event names at serving/resilience emission sites.
+
+    The name registry (``SERVE_SPANS``/``RESILIENCE_INSTANTS``/... in
+    :mod:`theanompi_tpu.telemetry.metrics`) exists so the emitting site,
+    the health detectors, tmhealth and the fleet aggregator all agree on
+    one spelling.  A string literal at the call site bypasses it: the
+    event still writes, nothing consumes it, and nothing fails loudly.
+    Bind the registered tuple to a module constant and pass that.
+    """
+
+    name = "telemetry-registered-names"
+    severity = SEV_ERROR
+    description = ("string-literal telemetry event name in serving/ or "
+                   "resilience/ — use the registered names from "
+                   "telemetry/metrics.py")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        if not src.rel.startswith(REGISTERED_NAME_PREFIXES):
+            return
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _EMIT_NAME_ATTRS
+                    and node.args):
+                continue
+            first = node.args[0]
+            literal = (isinstance(first, ast.Constant)
+                       and type(first.value) is str)
+            if not (literal or isinstance(first, ast.JoinedStr)):
+                continue
+            shown = (repr(first.value) if literal
+                     else "an f-string")
+            yield self.finding(
+                src, first.lineno, first.col_offset,
+                f"event name {shown} passed as a literal to "
+                f".{node.func.attr}() — bind the registered name from "
+                f"theanompi_tpu.telemetry.metrics so detectors and "
+                f"aggregators see the same spelling")

@@ -103,6 +103,10 @@ class JobRecord:
     #: that victims left cooperatively (75 = cadence checkpoint written),
     #: since ``last_exit`` is overwritten by the resumed episode
     preempt_exits: list = dataclasses.field(default_factory=list)
+    #: why the job failed (ISSUE 13): supervisor classification plus the
+    #: final attempt's flight-recorder blackbox summary / health verdicts
+    #: when the child left them; None until the job fails
+    failure_cause: dict | None = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

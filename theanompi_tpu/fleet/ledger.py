@@ -52,6 +52,9 @@ class DeviceLedger:
             self.pool_size = int(state["pool_size"])
             self.leases = {str(k): int(v)
                            for k, v in state["leases"].items()}
+            # optional (ISSUE 13) — a pre-13 ledger has no failures map
+            self.failures = {str(k): v for k, v
+                             in (state.get("failures") or {}).items()}
             if pool_size is not None and int(pool_size) != self.pool_size:
                 raise LedgerError(
                     f"--pool-size {pool_size} conflicts with the persisted "
@@ -66,6 +69,7 @@ class DeviceLedger:
                     "no persisted ledger, and the device probe failed")
             self.pool_size = int(pool_size)
             self.leases: dict[str, int] = {}
+            self.failures: dict[str, dict] = {}
             self.persist()
 
     # -- leases --------------------------------------------------------------
@@ -102,11 +106,20 @@ class DeviceLedger:
             self.persist()
         return freed
 
+    def record_failure(self, job_id: str, cause: dict) -> None:
+        """Persist ``job_id``'s failure cause (ISSUE 13): the supervisor
+        classification plus the blackbox summary the dead child left, so
+        ``tmfleet status`` of a long-gone job still answers *why*."""
+        self.failures[str(job_id)] = dict(cause)
+        self.persist()
+
     # -- crash-safe persistence ----------------------------------------------
     def persist(self) -> None:
         data = {"version": 1, "pool_size": self.pool_size,
                 "leases": dict(sorted(self.leases.items())),
                 "generation": self._persists}
+        if self.failures:
+            data["failures"] = dict(sorted(self.failures.items()))
         with open(self.path + ".tmp", "w") as f:
             json.dump(data, f, indent=1)
         if os.path.exists(self.path):

@@ -42,6 +42,7 @@ from theanompi_tpu.fleet.jobs import (
     write_record,
 )
 from theanompi_tpu.fleet.ledger import DeviceLedger
+from theanompi_tpu.telemetry.health import hung_verdict, read_health
 
 
 def read_fleet_events(fleet_dir: str) -> list[dict]:
@@ -90,6 +91,9 @@ class FleetScheduler:
         self._threads: dict[str, threading.Thread] = {}
         self._sups: dict[str, object] = {}
         self._launches = 0
+        self._episode_wall: dict[str, float] = {}  #: launch wall time
+        self._hung_flagged: set[str] = set()
+        self._next_health_s = 0.0
         self._telemetry = None
         self._telemetry_enabled = bool(telemetry)
         self.events_path = os.path.join(fleet_dir, "fleet_events.jsonl")
@@ -157,6 +161,7 @@ class FleetScheduler:
                     self._reap()
                     self._adopt_new()
                     self._schedule_pass()
+                    self._health_pass()
                     if self.records and all(
                             r.status in TERMINAL
                             for r in self.records.values()):
@@ -245,6 +250,39 @@ class FleetScheduler:
                 self._preempt(victim, for_job=spec.job_id)
             break  # head job owns the pass until it launches
 
+    def _health_pass(self) -> None:
+        """Surface fresh critical hang verdicts from running jobs'
+        ``HEALTH.json`` as ``fleet.hang`` audit events (ISSUE 13).
+
+        The actual preempt-and-restart is the job's own supervisor's
+        move — it watches the same file and kills the wedged child
+        instead of waiting out its lease/hang-timeout; the fleet's role
+        is the audit trail.  Emitted once per hang episode (cleared when
+        the verdict clears or the episode ends), gated on the file
+        postdating this episode's launch so a previous episode's dying
+        verdict is not re-reported."""
+        now = time.perf_counter()
+        if now < self._next_health_s:
+            return
+        self._next_health_s = now + 0.5
+        for jid, rec in self.records.items():
+            if rec.status != "running":
+                continue
+            tdir = os.path.join(job_dir(self.fleet_dir, jid), "telemetry")
+            health = read_health(tdir)
+            hung = None
+            launched = self._episode_wall.get(jid, float("inf"))
+            if health is not None and float(
+                    health.get("updated", 0.0)) >= launched:
+                hung = hung_verdict(health)
+            if hung is not None and jid not in self._hung_flagged:
+                self._hung_flagged.add(jid)
+                self._event("fleet.hang", job=jid,
+                            reason=hung.get("reason"),
+                            step=health.get("steps"))
+            elif hung is None:
+                self._hung_flagged.discard(jid)
+
     def _launch(self, rec: JobRecord, n: int) -> None:
         jid = rec.spec.job_id
         if not self.ledger.alloc(jid, n):
@@ -258,6 +296,8 @@ class FleetScheduler:
         write_record(self.fleet_dir, rec)
         self._event("fleet.resume" if resume else "fleet.schedule",
                     job=jid, devices=n, priority=rec.spec.priority)
+        # lint: wall-ok — gates HEALTH.json freshness by its wall stamp
+        self._episode_wall[jid] = time.time()
         kill_child = (
             self.fault_plan is not None
             and self.fault_plan.fire("fleet", self._launches,
@@ -312,6 +352,8 @@ class FleetScheduler:
             env=env)
         with self._lock:
             self.ledger.release(jid)
+            self._episode_wall.pop(jid, None)
+            self._hung_flagged.discard(jid)
             rec.devices = None
             rec.last_exit = result.exit_code
             if result.preempted:
@@ -325,8 +367,22 @@ class FleetScheduler:
                             exit_code=result.exit_code)
             else:
                 rec.status = "failed"
+                # ISSUE 13: the durable answer to "why did it fail" —
+                # supervisor classification + the final attempt's
+                # blackbox/health harvest (supervisor already mtime-gated
+                # them into the attempt record) — lands on the job record
+                # AND in the ledger's failures map
+                cause = {"cause": result.cause,
+                         "exit_code": result.exit_code}
+                last = result.attempts[-1] if result.attempts else {}
+                for k in ("blackbox", "health"):
+                    if k in last:
+                        cause[k] = last[k]
+                rec.failure_cause = cause
+                self.ledger.record_failure(jid, cause)
                 self._event("fleet.fail", job=jid,
-                            exit_code=result.exit_code, cause=result.cause)
+                            exit_code=result.exit_code, cause=result.cause,
+                            blackbox=bool(last.get("blackbox")))
             write_record(self.fleet_dir, rec)
 
     @staticmethod

@@ -149,7 +149,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="enable structured telemetry: per-rank JSONL event "
                    "sinks under this dir; rank 0 writes trace.json "
                    "(Perfetto-loadable) + summary.json (cross-rank skew) "
-                   "at the end of the run")
+                   "at the end of the run.  Also enables live health "
+                   "(HEALTH.json verdicts — watch with tmhealth) and the "
+                   "crash flight recorder (blackbox.json); tune/disable "
+                   "via --rule-set telemetry_health=... / "
+                   "telemetry_blackbox=N (ISSUE 13).  Under --supervise "
+                   "a critical hang verdict kills and restarts the child "
+                   "without waiting out --hang-timeout")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--compile-cache-dir", default=None,
                    help="persistent XLA compilation-cache directory, shared "

@@ -926,8 +926,16 @@ class BaseTrainer:
             # steady state because dispatched work must drain through the
             # donated-buffer chain
             dur = time.perf_counter() - step_t0
-            tel.emit_span("train.step", step_t0, dur,
-                          step=step_idx, epoch=epoch_idx)
+            # loss tag ONLY at fenced boundary steps (ISSUE 13): the cost
+            # is already materialized by the calc fence above, so float()
+            # is free here; tagging every step would add a per-step sync.
+            # The health monitor's NaN/spike detector keys on this tag.
+            if fence is not None:
+                tel.emit_span("train.step", step_t0, dur, step=step_idx,
+                              epoch=epoch_idx, loss=float(fence))
+            else:
+                tel.emit_span("train.step", step_t0, dur,
+                              step=step_idx, epoch=epoch_idx)
             tel.observe("train.step_s", dur)
             if not self._first_step_emitted:
                 # first-compile visibility (ISSUE 3): the first dispatch
@@ -1207,6 +1215,12 @@ class BaseTrainer:
                     self._watchdog.pause()
                 elif self._heartbeat is not None:
                     self._heartbeat.beat(self.iteration, force=True)
+                if self.telemetry is not None:
+                    # boundary bracket (ISSUE 13): the health monitor
+                    # suspends hang detection between begin and end, for
+                    # the same reason the watchdog pauses here
+                    self.telemetry.instant("train.boundary", epoch=epoch,
+                                           phase="begin")
                 try:
                     if self.sentinel is not None:
                         # enforce pending observations BEFORE the boundary
@@ -1224,6 +1238,9 @@ class BaseTrainer:
                     val = self.validate(epoch)
                     self.save_checkpoint(epoch)
                 finally:
+                    if self.telemetry is not None:
+                        self.telemetry.instant("train.boundary",
+                                               epoch=epoch, phase="end")
                     if self._watchdog is not None:
                         self._watchdog.resume()
                     elif self._heartbeat is not None:
@@ -1266,6 +1283,11 @@ class BaseTrainer:
             self.compile_iter_fns()
         if self.params is None:
             self.init_state()
+        if (self.telemetry is not None
+                and self.telemetry.flight is not None):
+            # the blackbox dump of a crashed run carries the topology it
+            # died under (mesh axes, exchange strategy, model identity)
+            self.telemetry.flight.set_fingerprint(self._run_fingerprint())
         model = self.model
         guard = None
         if self.resilience.preemption_enabled():
@@ -1416,6 +1438,13 @@ class Rule:
             directory,
             max_bytes=self.config.get("telemetry_max_bytes", 32 * 2**20),
             keep=self.config.get("telemetry_keep", 3),
+            # ISSUE 13: health detectors + crash flight recorder default ON
+            # whenever telemetry itself is on.  ``telemetry_health`` takes
+            # False, True, or a dict of HealthConfig overrides;
+            # ``telemetry_blackbox`` is the event-ring capacity (0 = off)
+            health=self.config.get("telemetry_health", True),
+            flight_recorder=int(
+                self.config.get("telemetry_blackbox", 256) or 0),
         )
 
     def adjust_model_config(self, model_config: dict, n_workers: int) -> None:
@@ -1469,6 +1498,22 @@ class Rule:
         try:
             return self.trainer.run()
         finally:
+            exc = sys.exc_info()[1]
+            if tel is not None and tel.flight is not None and exc is not None:
+                # last words BEFORE close(): the flight recorder dumps the
+                # event ring + verdicts + fingerprint for any exception
+                # escaping training, including the cooperative
+                # PreemptionExit (a preempted run's blackbox is its proof
+                # of orderly death)
+                try:
+                    tel.flight.dump(
+                        ("preemption" if isinstance(exc, PreemptionExit)
+                         else "crash"),
+                        health=(tel.health.verdicts()
+                                if tel.health is not None else None),
+                        error=f"{type(exc).__name__}: {exc}")
+                except OSError as e:
+                    print(f"blackbox dump failed: {e}", file=sys.stderr)
             if tel is not None:
                 # best-effort: a full disk / dead shared mount here (often
                 # correlated with whatever killed training) must not mask
@@ -1483,6 +1528,4 @@ class Rule:
 
                         aggregate.finalize(tel.directory)
                 except Exception as e:
-                    import sys
-
                     print(f"telemetry finalize failed: {e}", file=sys.stderr)

@@ -46,6 +46,10 @@ import threading
 import numpy as np
 
 from theanompi_tpu.resilience.codes import EXIT_PREEMPTED
+from theanompi_tpu.telemetry.metrics import RESILIENCE_INSTANTS
+
+# registered event names (tmlint telemetry-registered-names)
+SENTINEL_SKIP, SENTINEL_NONFINITE = RESILIENCE_INSTANTS[1:3]
 
 POLICIES = ("abort", "skip_batch", "rollback")
 
@@ -135,7 +139,7 @@ class Sentinel:
                 n = float(np.max(np.asarray(skip_flag)))
                 if n > 0:
                     self.skips += n
-                    self._emit("sentinel.skip", step=step,
+                    self._emit(SENTINEL_SKIP, step=step,
                                total_skips=self.skips)
                     print(f"sentinel: skipped non-finite update at step "
                           f"{step} ({self.skips:g}/{self.max_skips} budget)",
@@ -150,7 +154,7 @@ class Sentinel:
                 continue
             if bool(np.isfinite(np.asarray(cost)).all()):
                 continue
-            self._emit("sentinel.nonfinite", step=step, policy=self.policy)
+            self._emit(SENTINEL_NONFINITE, step=step, policy=self.policy)
             if self.policy == "rollback":
                 raise SentinelRollback(step)
             raise NonFiniteLossError(

@@ -279,6 +279,9 @@ class Supervisor:
     def _wait(self, proc: subprocess.Popen,
               started_s: float) -> tuple[int, bool]:
         """Poll the child; -> (returncode, killed_as_hung)."""
+        # lint: wall-ok — compared against HEALTH.json file mtimes
+        wall0 = time.time()
+        next_health = 0.0
         while True:
             rc = proc.poll()
             if rc is not None:
@@ -297,7 +300,60 @@ class Supervisor:
                               f"hung child pid {proc.pid}")
                     proc.kill()
                     return proc.wait(), True
+            # ISSUE 13: the child's own health monitor publishing a
+            # critical hang verdict beats waiting out hang_timeout_s —
+            # preempt-and-restart NOW instead of trusting the blunt
+            # mtime backstop (checked ~1/s, not every poll tick)
+            if self.telemetry_dir:
+                nowp = time.perf_counter()
+                if nowp >= next_health:
+                    next_health = nowp + 1.0
+                    v = self._health_hung(wall0)
+                    if v is not None:
+                        self._log(
+                            f"health: critical hang verdict "
+                            f"({v.get('reason', 'no reason')}); killing "
+                            f"hung child pid {proc.pid}")
+                        proc.kill()
+                        return proc.wait(), True
             time.sleep(self.poll_s)
+
+    def _health_hung(self, wall0: float) -> dict | None:
+        """A FRESH critical hang verdict from the child's ``HEALTH.json``
+        (ISSUE 13), or None.  Freshness is the file mtime vs this
+        attempt's wall start — a previous attempt's dying verdict must
+        never kill a healthy restart.  Plain ``json``: this stdlib-only
+        module does not import the telemetry package."""
+        path = os.path.join(self.telemetry_dir, "HEALTH.json")
+        try:
+            if os.stat(path).st_mtime <= wall0:
+                return None
+            with open(path) as f:
+                health = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if not isinstance(health, dict):
+            return None
+        for v in health.get("verdicts", []):
+            if (isinstance(v, dict) and v.get("detector") == "hang"
+                    and v.get("severity") == "critical"):
+                return v
+        return None
+
+    def _fresh_json(self, filename: str, wall0: float) -> dict | None:
+        """Parse ``<telemetry_dir>/<filename>`` when its mtime postdates
+        this attempt's wall start; None otherwise."""
+        if not self.telemetry_dir:
+            return None
+        path = os.path.join(self.telemetry_dir, filename)
+        try:
+            if os.stat(path).st_mtime < wall0:
+                return None
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return data if isinstance(data, dict) else None
 
     def _backoff_s(self, restarts: int) -> float:
         base = min(self.backoff_cap,
@@ -385,6 +441,8 @@ class Supervisor:
             cmd = self._attempt_cmd(attempt)
             self._log(f"attempt {attempt}: {' '.join(cmd)}")
             t0 = time.perf_counter()
+            # lint: wall-ok — gates blackbox/HEALTH harvesting by mtime
+            wall_t0 = time.time()
             proc = subprocess.Popen(cmd, env=self._attempt_env(attempt))
             self._proc = proc
             rc, hung = self._wait(proc, t0)
@@ -404,6 +462,23 @@ class Supervisor:
                 # progress since the last published checkpoint is gone; the
                 # attempt's whole duration is the honest upper bound
                 rec["time_lost_s"] = round(dur, 3)
+            # ISSUE 13: harvest the attempt's flight-recorder dump and
+            # final health verdicts into the attempt record (mtime-gated:
+            # a stale file from an earlier attempt is not THIS death).
+            # The blackbox summary drops the event ring — resilience.json
+            # is the index; the full ring stays in blackbox.json
+            bb = self._fresh_json("blackbox.json", wall_t0)
+            if bb is not None:
+                rec["blackbox"] = {
+                    k: bb[k] for k in ("reason", "error", "wall_time",
+                                       "pid", "rank", "n_events",
+                                       "fingerprint") if k in bb}
+            hv = self._fresh_json("HEALTH.json", wall_t0)
+            if hv is not None:
+                bad = [v for v in hv.get("verdicts", [])
+                       if isinstance(v, dict) and v.get("severity") != "ok"]
+                if bad:
+                    rec["health"] = bad
             self.attempts.append(rec)
             self._emit({"name": "supervisor.attempt", **rec})
             if cause == "clean":

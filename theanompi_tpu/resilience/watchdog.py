@@ -31,6 +31,11 @@ import time
 from collections import deque
 
 from theanompi_tpu.resilience.codes import EXIT_HANG
+from theanompi_tpu.telemetry.metrics import RESILIENCE_INSTANTS
+
+# registered event name (tmlint telemetry-registered-names): emissions
+# from this package must come from the telemetry/metrics.py registry
+WATCHDOG_STALL = RESILIENCE_INSTANTS[0]
 
 
 class Heartbeat:
@@ -174,11 +179,25 @@ class Watchdog:
                f"median) at step {step}")
         print(msg, file=sys.stderr, flush=True)
         if self.telemetry is not None:
-            self.telemetry.instant("watchdog.stall", step=step,
+            self.telemetry.instant(WATCHDOG_STALL, step=step,
                                    stalled_s=stalled_s,
                                    threshold_s=threshold,
                                    escalate=self.escalate)
         if self.escalate == "exit":
+            flight = getattr(self.telemetry, "flight", None)
+            if flight is not None:
+                # last words before the hard exit (ISSUE 13): os._exit
+                # runs no atexit/finally, so this dump is the ONLY
+                # artifact a hang leaves beyond the exit code
+                health = getattr(self.telemetry, "health", None)
+                try:
+                    flight.dump("hang",
+                                health=(health.verdicts()
+                                        if health is not None else None),
+                                error=msg)
+                except OSError:
+                    pass  # lint: swallow-ok — the exit must proceed even
+                    #       when the blackbox write fails (full disk)
             sys.stderr.flush()
             self._exit(self.exit_code)
         return True

@@ -85,7 +85,12 @@ def build_parser() -> argparse.ArgumentParser:
     # -- output ------------------------------------------------------------
     p.add_argument("--telemetry-dir", default=None,
                    help="serve.prefill/serve.decode spans + serve.* "
-                   "gauges as per-rank JSONL (trace.json exported at exit)")
+                   "gauges as per-rank JSONL (trace.json exported at exit; "
+                   "also enables live HEALTH.json — see tmhealth)")
+    p.add_argument("--slo-ttft-ms", type=float, default=None,
+                   help="serving SLO (ISSUE 13): flag a health verdict "
+                   "when the live p99 time-to-first-token exceeds this "
+                   "many ms (requires --telemetry-dir)")
     p.add_argument("--out", default=None,
                    help="write the report dict as JSON here (SERVE.json)")
     p.add_argument("--quiet", action="store_true")
@@ -159,7 +164,13 @@ def serve(args) -> dict:
     if args.telemetry_dir:
         from theanompi_tpu.telemetry import Telemetry
 
-        telemetry = Telemetry(args.telemetry_dir)
+        # ISSUE 13: live health rides the telemetry opt-in, same default
+        # as training; --slo-ttft-ms arms the serving SLO detector
+        health: bool | dict = True
+        if args.slo_ttft_ms is not None:
+            health = {"slo_ttft_p99_ms": float(args.slo_ttft_ms)}
+        telemetry = Telemetry(args.telemetry_dir, health=health,
+                              flight_recorder=256)
 
     engine = InferenceEngine(
         model, params, block_size=args.block_size,

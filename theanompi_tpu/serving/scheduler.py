@@ -278,6 +278,12 @@ class Scheduler:
                 self.telemetry.observe(_HIST_TOKEN_MS, step_ms)
             if self._done(req):
                 self._finish(slot, finished)
+        if self.telemetry is not None and self.n_steps % 16 == 0:
+            # periodic flush (ISSUE 13): the ttft/token histograms must
+            # reach the event stream while serving is LIVE — the health
+            # monitor's SLO detector reads p99 from ``metrics`` events,
+            # and a flush only at shutdown would blind it
+            self.telemetry.flush_metrics(step=self.n_steps)
         return finished
 
 

@@ -12,7 +12,15 @@ which emitted structured events.  This package is the common substrate:
   trace-event format so host-side spans render in Perfetto alongside the
   ``profile_dir`` device traces;
 - :mod:`~theanompi_tpu.telemetry.aggregate` — rank-0 merge + cross-rank
-  step-skew / straggler summary for the multihost path.
+  step-skew / straggler summary for the multihost path;
+- :mod:`~theanompi_tpu.telemetry.health` — streaming health detectors
+  (hang, straggler skew, loss spike/NaN, throughput regression,
+  checkpoint stall, serving SLO) publishing typed verdicts to
+  ``HEALTH.json`` (ISSUE 13);
+- :mod:`~theanompi_tpu.telemetry.flight_recorder` — bounded in-memory
+  event ring dumped as ``blackbox.json`` on crash/SIGTERM;
+- :mod:`~theanompi_tpu.telemetry.cli` — the ``tmhealth`` CLI
+  (``python -m theanompi_tpu.telemetry``).
 
 Everything is off by default: the trainer holds ``telemetry=None`` unless
 a sink was configured (``telemetry_dir`` rule config / ``--telemetry-dir``
@@ -21,6 +29,17 @@ run makes zero telemetry calls on the hot path.
 """
 
 from theanompi_tpu.telemetry.core import Span, Telemetry
+from theanompi_tpu.telemetry.flight_recorder import (
+    FlightRecorder,
+    read_blackbox,
+)
+from theanompi_tpu.telemetry.health import (
+    HealthConfig,
+    HealthMonitor,
+    hung_verdict,
+    read_health,
+    replay_events,
+)
 from theanompi_tpu.telemetry.metrics import (
     MetricsRegistry,
     device_memory_stats,
@@ -28,17 +47,30 @@ from theanompi_tpu.telemetry.metrics import (
     peak_flops,
     step_flops_estimate,
 )
-from theanompi_tpu.telemetry.sink import EventSink, read_events, sink_files
+from theanompi_tpu.telemetry.sink import (
+    EventSink,
+    read_events,
+    sink_files,
+    tail_events,
+)
 
 __all__ = [
     "EventSink",
+    "FlightRecorder",
+    "HealthConfig",
+    "HealthMonitor",
     "MetricsRegistry",
     "Span",
     "Telemetry",
     "device_memory_stats",
+    "hung_verdict",
     "mfu",
     "peak_flops",
+    "read_blackbox",
     "read_events",
+    "read_health",
+    "replay_events",
     "sink_files",
     "step_flops_estimate",
+    "tail_events",
 ]

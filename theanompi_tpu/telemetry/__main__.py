@@ -1,0 +1,8 @@
+"""``python -m theanompi_tpu.telemetry`` — the ``tmhealth`` CLI."""
+
+import sys
+
+from theanompi_tpu.telemetry.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,164 @@
+"""``tmhealth`` — print/refresh live health verdicts (ISSUE 13).
+
+Tails one telemetry directory, or every per-job telemetry directory of a
+fleet dir (``--fleet``), and prints each run's verdicts:
+
+    tmhealth ./telemetry                 # one run, one shot
+    tmhealth ./pool --fleet --follow     # whole fleet, refreshing
+    python -m theanompi_tpu.telemetry ./telemetry   # same entry point
+
+The live path reads the atomically-published ``HEALTH.json`` the run's
+in-process monitor maintains.  When no ``HEALTH.json`` exists (the run
+predates ISSUE 13, or health was disabled), the detectors are replayed
+offline over the event files — arrival-clock hang detection is then
+judged from sink-file staleness instead, since recorded ``ts`` values
+are per-process epochs.
+
+Exit contract (plain codes — this is a read-only reporting tool, not a
+party to the supervisor's 70/75–79 contract): ``0`` no critical
+verdicts, ``1`` at least one critical, ``2`` usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+from theanompi_tpu.telemetry.health import (
+    SEV_CRITICAL,
+    SEV_OK,
+    read_health,
+    replay_events,
+)
+from theanompi_tpu.telemetry.sink import read_events, sink_files
+
+#: replayed runs with no session_end whose newest event file is older
+#: than this are reported hung (the offline stand-in for the live
+#: arrival-clock deadline)
+STALE_HANG_S = 60.0
+
+
+def scan_dir(directory: str, stale_hang_s: float = STALE_HANG_S) -> dict:
+    """-> {"dir", "source", "updated_s" | None, "verdicts": [...]}"""
+    health = read_health(directory)
+    now = time.time()  # lint: wall-ok — compared against file mtimes
+    if health is not None:
+        return {
+            "dir": directory,
+            "source": "HEALTH.json",
+            "updated_s": round(now - float(health.get("updated", now)), 1),
+            "steps": health.get("steps"),
+            "verdicts": list(health.get("verdicts", [])),
+        }
+    files = sink_files(directory)
+    events: list[dict] = []
+    for p in files:
+        events.extend(read_events(p))
+    mon = replay_events(events, directory=directory)
+    verdicts = mon.verdicts()
+    ended = any(ev.get("kind") == "meta" and ev.get("name") == "session_end"
+                for ev in events)
+    if files and not ended:
+        age = now - max(os.path.getmtime(p) for p in files
+                        if os.path.exists(p))
+        if age > stale_hang_s:
+            verdicts.append({
+                "detector": "hang", "severity": SEV_CRITICAL,
+                "reason": (f"no session_end and event files idle for "
+                           f"{age:.0f}s"),
+                "fields": {"stalled_s": round(age, 1),
+                           "deadline_s": stale_hang_s}})
+    return {"dir": directory, "source": "replay", "updated_s": None,
+            "steps": None, "verdicts": verdicts}
+
+
+def fleet_telemetry_dirs(fleet_dir: str) -> list[str]:
+    """Per-job telemetry dirs of a fleet dir (the ``jobs/<id>/telemetry``
+    layout the FleetScheduler creates)."""
+    return sorted(glob.glob(os.path.join(fleet_dir, "jobs", "*",
+                                         "telemetry")))
+
+
+def _format(report: dict) -> str:
+    lines = []
+    where = report["dir"]
+    src = report["source"]
+    upd = report.get("updated_s")
+    head = f"{where}  [{src}" + (
+        f", updated {upd:.0f}s ago]" if upd is not None else "]")
+    lines.append(head)
+    verdicts = report["verdicts"]
+    if not verdicts:
+        lines.append("  (no verdicts — no health data and no events)")
+    for v in verdicts:
+        sev = v.get("severity", SEV_OK)
+        mark = {"ok": " ", "warn": "!", "critical": "X"}.get(sev, "?")
+        step = v.get("step")
+        at = f" @step {step}" if step is not None else ""
+        lines.append(f"  [{mark}] {v.get('detector'):<11} {sev:<8} "
+                     f"{v.get('reason', '')}{at}")
+    return "\n".join(lines)
+
+
+def _scan_all(dirs: list[str], stale_hang_s: float) -> list[dict]:
+    return [scan_dir(d, stale_hang_s) for d in dirs]
+
+
+def _any_critical(reports: list[dict]) -> bool:
+    return any(v.get("severity") == SEV_CRITICAL
+               for rep in reports for v in rep["verdicts"])
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tmhealth",
+        description="Print/refresh live run-health verdicts from a "
+                    "telemetry dir (or a fleet's per-job dirs)")
+    p.add_argument("directory",
+                   help="telemetry dir, or a fleet dir with --fleet")
+    p.add_argument("--fleet", action="store_true",
+                   help="treat DIRECTORY as a fleet dir: scan every "
+                        "jobs/<id>/telemetry under it")
+    p.add_argument("--follow", action="store_true",
+                   help="refresh until interrupted")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh interval seconds (with --follow)")
+    p.add_argument("--stale-hang-s", type=float, default=STALE_HANG_S,
+                   help="offline replay: report hang when event files "
+                        "are idle this long without a session_end")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output (one JSON doc per scan)")
+    args = p.parse_args(argv)
+
+    if not os.path.isdir(args.directory):
+        print(f"tmhealth: error: no such directory: {args.directory}",
+              file=sys.stderr)
+        return 2
+    while True:
+        if args.fleet:
+            dirs = fleet_telemetry_dirs(args.directory)
+            if not dirs:
+                print(f"tmhealth: error: no jobs/*/telemetry under "
+                      f"{args.directory}", file=sys.stderr)
+                return 2
+        else:
+            dirs = [args.directory]
+        reports = _scan_all(dirs, args.stale_hang_s)
+        if args.as_json:
+            print(json.dumps({"reports": reports}, indent=1), flush=True)
+        else:
+            print("\n".join(_format(r) for r in reports), flush=True)
+        if not args.follow:
+            return 1 if _any_critical(reports) else 0
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 1 if _any_critical(reports) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

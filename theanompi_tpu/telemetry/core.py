@@ -76,7 +76,7 @@ class Telemetry:
 
     def __init__(self, directory: str, rank: int | None = None,
                  host: str | None = None, max_bytes: int = 32 * 2**20,
-                 keep: int = 3):
+                 keep: int = 3, health=None, flight_recorder: int = 0):
         if rank is None:
             try:
                 import jax
@@ -90,6 +90,34 @@ class Telemetry:
         self.sink = EventSink(directory, rank=rank, max_bytes=max_bytes,
                               keep=keep)
         self.metrics = MetricsRegistry()
+        # ISSUE 13 live health: both default OFF — a Telemetry constructed
+        # the pre-13 way makes zero health/flight-recorder calls (same
+        # off-means-off contract the trainer holds for telemetry itself).
+        # ``health`` accepts True (defaults), a HealthConfig, or a dict of
+        # HealthConfig overrides; ``flight_recorder`` is the ring capacity.
+        self.flight = None
+        self.health = None
+        self._health_stop: threading.Event | None = None
+        self._health_thread: threading.Thread | None = None
+        if flight_recorder:
+            from theanompi_tpu.telemetry.flight_recorder import FlightRecorder
+
+            self.flight = FlightRecorder(directory,
+                                         capacity=int(flight_recorder),
+                                         rank=rank)
+        if health:
+            from theanompi_tpu.telemetry.health import (HealthConfig,
+                                                        HealthMonitor)
+
+            cfg = (health if isinstance(health, HealthConfig)
+                   else HealthConfig(**health) if isinstance(health, dict)
+                   else HealthConfig())
+            self.health = HealthMonitor(directory, cfg, rank=rank)
+            self._health_stop = threading.Event()
+            self._health_thread = threading.Thread(
+                target=self._health_loop, name="telemetry-health",
+                daemon=True)
+            self._health_thread.start()
         self.emit("meta", "session",
                   wall_time=datetime.now(timezone.utc).isoformat(),
                   host=self.host, pid=os.getpid())
@@ -101,6 +129,10 @@ class Telemetry:
                  "kind": kind, "name": name, "rank": self.rank}
         event.update(fields)
         self.sink.emit(event)
+        if self.flight is not None:
+            self.flight.record(event)
+        if self.health is not None:
+            self.health.observe(event)
 
     def emit_span(self, name: str, t0: float, dur: float, **tags) -> None:
         self.emit("span", name, ts=t0, dur=dur,
@@ -150,7 +182,54 @@ class Telemetry:
         return export_chrome_trace(
             sink_files(self.directory, rank=self.rank), path)
 
+    # -- live health (ISSUE 13) ----------------------------------------------
+    def _health_loop(self) -> None:
+        """Daemon ticker: exists only when health is enabled.  Runs the
+        time-based detectors and republishes ``HEALTH.json`` even while
+        the main thread is wedged — which is exactly when the hang
+        verdict matters."""
+        while not self._health_stop.wait(self.health.config.tick_s):
+            self._health_tick()
+
+    def _health_tick(self) -> None:
+        from theanompi_tpu.telemetry.metrics import HEALTH_INSTANTS
+
+        changed = self.health.tick()
+        for v in changed:
+            # mirror severity *transitions* into the event stream (the
+            # tick released the monitor's lock before we emit, so the
+            # observe() this triggers cannot deadlock)
+            self.instant(HEALTH_INSTANTS[1], detector=v.detector,
+                         severity=v.severity, reason=v.reason)
+        if self.flight is not None and any(
+                v.detector == "hang" and v.severity == "critical"
+                for v in changed):
+            # last words while still alive: the supervisor answers a
+            # critical hang with SIGKILL, which a wedged process cannot
+            # dump under — so the ticker dumps the moment the verdict
+            # turns, leaving the blackbox the harvest expects
+            try:
+                self.flight.dump("hang", health=self.health.verdicts())
+            except OSError:
+                pass  # lint: swallow-ok — advisory file; verdict stands
+        try:
+            self.health.write()
+        except OSError:
+            pass  # lint: swallow-ok — advisory file; next tick retries
+
     def close(self) -> None:
+        if self._health_thread is not None:
+            self._health_stop.set()
+            self._health_thread.join(timeout=5.0)
+            self._health_thread = None
         self.flush_metrics()
         self.emit("meta", "session_end")
+        if self.health is not None:
+            # final publish AFTER session_end so the file's last word is
+            # the disarmed, end-of-run state
+            self.health.tick()
+            try:
+                self.health.write()
+            except OSError:
+                pass  # lint: swallow-ok — advisory file at shutdown
         self.sink.close()

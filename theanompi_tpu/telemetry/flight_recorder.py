@@ -1,0 +1,115 @@
+"""Crash flight recorder: a bounded ring of recent events, dumped on
+death (ISSUE 13).
+
+A crashed or SIGTERMed run used to leave only an exit code and whatever
+the sink had flushed.  The :class:`FlightRecorder` keeps the last
+``capacity`` events in memory (fed by ``Telemetry.emit``, zero I/O per
+event) plus the run's mesh/strategy fingerprint, and ``dump()`` writes an
+atomic ``blackbox.json`` — last spans, health verdicts, the fingerprint,
+the failure reason — at the moment of death:
+
+- ``Rule.wait`` dumps on any exception escaping the training loop
+  (including the cooperative-preemption ``PreemptionExit``);
+- the resilience watchdog dumps right before its hang ``os._exit``;
+- a SIGKILL leaves nothing, by definition — the supervisor's attempt
+  record says so instead.
+
+``resilience/supervisor.py`` harvests the file into the attempt records
+of ``resilience.json`` and ``fleet/ledger.py`` persists it as the job's
+failure cause.  Consumers read with plain ``json``
+(:func:`read_blackbox` is a convenience, not a dependency).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+BLACKBOX_FILENAME = "blackbox.json"
+
+
+def blackbox_path(directory: str, rank: int = 0) -> str:
+    """Rank 0 owns the canonical name; other ranks get a suffixed file
+    (single-process runs — the common case — always write
+    ``blackbox.json``)."""
+    if rank == 0:
+        return os.path.join(directory, BLACKBOX_FILENAME)
+    return os.path.join(directory, f"blackbox-rank{rank:05d}.json")
+
+
+class FlightRecorder:
+    """Bounded in-memory event ring + one-shot crash dump.
+
+    Thread-safe: the train loop records while a watchdog/ticker thread
+    may dump.  ``dump`` is idempotent-by-overwrite — the *last* dump
+    wins, which is the right answer when a preemption dump is followed
+    by a watchdog dump of the same wedged process.
+    """
+
+    def __init__(self, directory: str, capacity: int = 256, rank: int = 0):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self.directory = directory
+        self.capacity = capacity
+        self.rank = rank
+        self._ring: deque = deque(maxlen=capacity)
+        self._fingerprint: dict = {}
+        self._lock = threading.Lock()
+
+    def record(self, event: dict) -> None:
+        with self._lock:
+            self._ring.append(event)
+
+    def set_fingerprint(self, fingerprint: dict) -> None:
+        """Attach the run-topology fingerprint (mesh axes, exchange
+        strategy, model identity) the dump should carry."""
+        with self._lock:
+            self._fingerprint.update(fingerprint)
+
+    def dump(self, reason: str, health: list | None = None,
+             error: str | None = None) -> str:
+        """Write ``blackbox.json`` atomically; -> its path.
+
+        Best-effort callers (watchdog pre-exit) catch OSError themselves;
+        this raises so test paths see real failures.
+        """
+        with self._lock:
+            events = list(self._ring)
+            fingerprint = dict(self._fingerprint)
+        payload = {
+            # wall stamp: the supervisor gates harvesting on file mtime
+            # vs its own wall clock; the payload stamp is the human copy
+            "wall_time": time.time(),  # lint: wall-ok — cross-process stamp
+            "reason": reason,
+            "pid": os.getpid(),
+            "rank": self.rank,
+            "fingerprint": fingerprint,
+            "n_events": len(events),
+            "events": events,
+        }
+        if error is not None:
+            payload["error"] = error
+        if health is not None:
+            payload["health"] = health
+        path = blackbox_path(self.directory, self.rank)
+        os.makedirs(self.directory, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, path)
+        return path
+
+
+def read_blackbox(directory: str, rank: int = 0) -> dict | None:
+    """Parse a dumped blackbox; None when absent/unreadable (a crashed
+    dumper can at worst leave the previous complete file — the write is
+    tmp + ``os.replace``)."""
+    try:
+        with open(blackbox_path(directory, rank)) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) else None

@@ -96,9 +96,34 @@ DATA_COUNTERS = ("data.retries",)
 # SIGTERMed to free devices for a higher-priority one (tags: job, victim_of);
 # ``fleet.resume``: a preempted job relaunched elastically on the devices
 # that remain (tags: job, devices); ``fleet.complete``/``fleet.fail``: a
-# job's final episode ended (tags: job, exit_code).
+# job's final episode ended (tags: job, exit_code); ``fleet.hang``: a
+# running job's HEALTH.json published a critical hang verdict (ISSUE 13 —
+# tags: job, reason, step; the job's supervisor does the kill+restart, this
+# event is the fleet-level audit line).
 FLEET_INSTANTS = ("fleet.schedule", "fleet.preempt", "fleet.resume",
-                  "fleet.complete", "fleet.fail")
+                  "fleet.complete", "fleet.fail", "fleet.hang")
+
+# -- resilience instant names (ISSUE 13) -------------------------------------
+# The resilience layer emits through these registered names ONLY (same
+# one-source-of-truth contract as the serving/reshard/data/fleet names, now
+# lint-enforced by the ``telemetry-registered-names`` rule).
+# ``watchdog.stall``: the adaptive watchdog flagged a stalled step (tags:
+# step, stalled_s, threshold_s, escalate); ``sentinel.skip``: the on-device
+# non-finite guard skipped a poisoned batch (tags: step, total_skips);
+# ``sentinel.nonfinite``: a host-side sentinel policy fired (tags: step,
+# policy).
+RESILIENCE_INSTANTS = ("watchdog.stall", "sentinel.skip",
+                       "sentinel.nonfinite")
+
+# -- live-health names (ISSUE 13) --------------------------------------------
+# ``train.boundary`` instants bracket the trainer's beat-free epoch-boundary
+# work (validate / checkpoint / prefetcher build) so the arrival-clock hang
+# detector suspends across it instead of flagging a healthy boundary (tags:
+# epoch, phase = "begin" | "end").  ``health.verdict`` mirrors each non-ok
+# verdict the in-process HealthMonitor writes to HEALTH.json into the event
+# stream (tags: detector, severity, reason).  Emitted through these
+# registered names ONLY (same one-source-of-truth contract as above).
+HEALTH_INSTANTS = ("train.boundary", "health.verdict")
 
 # -- overlapped-exchange / quantization-ramp names (ISSUE 12) -----------------
 # ``exchange.overlap``: span around (re)arming the chained step fn when

@@ -117,6 +117,41 @@ def read_events(path: str) -> list[dict]:
     return out
 
 
+def tail_events(path: str, offset: int = 0) -> tuple[list[dict], int]:
+    """Incrementally read complete events past ``offset`` bytes.
+
+    The live-tailing primitive (ISSUE 13): a reader racing the writer
+    must never consume a *partial* final line — the bytes after the last
+    newline stay un-consumed and the returned offset points at them, so
+    the next call re-reads the completed line.  A line that is complete
+    but unparseable (a torn write the writer abandoned across a rotation
+    boundary) is skipped, not raised.  A vanished file (rotated away
+    between the caller's listing and the read) is an empty result, not an
+    error.  -> (events, new_offset).
+    """
+    try:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            chunk = f.read()
+    except FileNotFoundError:
+        return [], offset
+    if not chunk:
+        return [], offset
+    end = chunk.rfind(b"\n")
+    if end < 0:
+        return [], offset  # only a partial line so far
+    out = []
+    for raw in chunk[:end].split(b"\n"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            out.append(json.loads(raw))
+        except json.JSONDecodeError:
+            continue  # torn mid-file write (reader raced a rotation)
+    return out, offset + end + 1
+
+
 def sink_files(directory: str, rank: int | None = None) -> list[str]:
     """All event files under ``directory`` in chronological order
     (oldest rotation first, live file last), optionally for one rank."""

@@ -152,7 +152,8 @@ def measure_comm_share(trainer, batches, steps: int = 6, lr: float = 0.01):
 
 
 def _build(model_name: str, model_config: dict, n: int, strategy: str,
-           bucket_mb: float = 4.0, overlap: bool = False):
+           bucket_mb: float = 4.0, overlap: bool = False,
+           telemetry_dir: str | None = None):
     import jax
 
     from theanompi_tpu.parallel.bsp import BSPTrainer
@@ -169,8 +170,18 @@ def _build(model_name: str, model_config: dict, n: int, strategy: str,
         cfg.setdefault("bn_axis", "data")  # BSP default: sync-BN
     model = model_cls(cfg)
     mesh = make_mesh(n_data=n, devices=jax.devices()[:n])
+    telemetry = None
+    if telemetry_dir:
+        # ISSUE 13: an opted-in bench rung is health-watchable live
+        # (tmhealth <dir>) — per-step spans add host overhead, so the
+        # measured numbers are only comparable to other telemetry-on runs
+        from theanompi_tpu.telemetry import Telemetry
+
+        telemetry = Telemetry(telemetry_dir, health=True,
+                              flight_recorder=256)
     trainer = BSPTrainer(model, mesh=mesh, exch_strategy=strategy,
                          exch_bucket_mb=bucket_mb, exch_overlap=overlap,
+                         telemetry=telemetry,
                          recorder=Recorder(verbose=False, print_freq=10**9))
     trainer.compile_iter_fns()
     trainer.init_state()
@@ -190,6 +201,7 @@ def measure_scaling(
     trials: int = 3,
     strategy: str = "psum",
     out_path: str | None = None,
+    telemetry_dir: str | None = None,
 ) -> dict:
     """-> the artifact dict (and writes it to ``out_path`` if given)."""
     import jax
@@ -206,12 +218,19 @@ def measure_scaling(
     # comm share at all (the n=1 rung has no collectives to profile)
     have_xplane = any(n > 1 for n in ns) and _have_xplane_protos()
     for n in ns:
-        trainer, batches = _build(model_name, model_config, n, strategy)
+        # per-rung telemetry subdir: each rung's sink would otherwise
+        # truncate the previous rung's events
+        tdir = (None if telemetry_dir is None
+                else f"{telemetry_dir}/n{int(n)}")
+        trainer, batches = _build(model_name, model_config, n, strategy,
+                                  telemetry_dir=tdir)
         # warmup: compile both programs' first dispatch
         m = trainer.train_iter(batches[0], lr=0.01)
         float(m["cost"])
         (dt, _, _), results = best_trial(trainer, batches, steps, trials)
         times = [r[0] for r in results]
+        if trainer.telemetry is not None:
+            trainer.telemetry.close()
 
         t_noex = dt
         comm_share = comm_s = 0.0
@@ -405,6 +424,11 @@ def main(argv=None):
                    help="extra model-config entry (repeatable; same syntax "
                    "as tmlauncher --set, e.g. --set image_size=64)")
     p.add_argument("--out", default="SCALING.json")
+    p.add_argument("--telemetry-dir", default=None,
+                   help="per-rung telemetry + live health under "
+                   "<dir>/n<N> (ISSUE 13; watch with tmhealth) — adds "
+                   "per-step span overhead, so compare only against "
+                   "other telemetry-on runs")
     p.add_argument("--virtual", type=int, default=0,
                    help="force N virtual host (CPU) devices first")
     p.add_argument("--compile-cache-dir", default=None,
@@ -462,7 +486,8 @@ def main(argv=None):
         return
     art = measure_scaling(args.model, cfg, ns=ns, steps=args.steps,
                           trials=args.trials, strategy=args.strategy,
-                          out_path=args.out)
+                          out_path=args.out,
+                          telemetry_dir=args.telemetry_dir)
     for n in art["ns"]:
         r = art["per_n"][n]
         comm = ("  n/a" if r["comm_share"] is None
