@@ -334,8 +334,6 @@ def run_serve_bench() -> dict:
     weights) / _CKPT (verified checkpoint dir) / _SET (semicolon-separated
     model k=v pairs layered over the bench transformer geometry).
     """
-    import argparse
-
     from theanompi_tpu.serving import cli as serve_cli
 
     env = os.environ.get
@@ -352,7 +350,11 @@ def run_serve_bench() -> dict:
     for pair in (env("BENCH_SERVE_SET", "") or "").split(";"):
         if pair.strip():
             model_set.append(pair.strip())
-    args = argparse.Namespace(
+    # start from the CLI parser's own defaults so new tmserve flags
+    # (deadlines, drain, rollout, ...) can never drift out of sync with
+    # this hand-built namespace
+    args = serve_cli.build_parser().parse_args([])
+    vars(args).update(
         modelfile="theanompi_tpu.models.transformer_lm",
         modelclass="TransformerLM", model_set=model_set,
         checkpoint_dir=env("BENCH_SERVE_CKPT") or None,

@@ -256,6 +256,60 @@ def test_runbook_serve_command(tmp_path, capsys):
     assert "serve.prefill" in names and "serve.decode" in names
 
 
+def test_runbook_serve_resilience_command(tmp_path):
+    """RUNBOOK step 6b (ISSUE 14): the resilient-serving flags of the
+    exact invocation — deadlines + --shed, --drain-s, --rollout-watch —
+    and the SERVE.json fields the runbook reads (terminal_states summing
+    to requests, the rollout block, attempt, REQUESTS.jsonl).  The
+    --supervise half (drain-under-SIGTERM, crash restart) is locked by
+    the subprocess e2es in tests/test_serving_resilience.py."""
+    import jax
+    import numpy as np
+
+    from theanompi_tpu.launcher import _parse_kv
+    from theanompi_tpu.models.transformer_lm import TransformerLM
+    from theanompi_tpu.serving import TERMINAL_STATES, terminal_rids
+    from theanompi_tpu.serving import cli as serve_cli
+    from theanompi_tpu.utils.checkpoint import Checkpointer, model_fingerprint
+
+    tiny = ["dim=32", "heads=2", "n_layers=1", "seq_len=32", "vocab=61",
+            "dropout=0.0", "precision=fp32", "n_train=64", "n_val=32"]
+    model = TransformerLM(_parse_kv(tiny))
+    params, _ = model.init_params(jax.random.PRNGKey(0))
+    ckpt = str(tmp_path / "ckpt")
+    writer = Checkpointer(ckpt, fingerprint={
+        "mesh": {"data": 8}, "exchange": "psum_bf16_bucket", "n_subb": 1,
+        **model_fingerprint(model)})
+    writer.save(0, 5, {"params": jax.tree.map(np.asarray, params)})
+    writer.mark_clean()
+
+    out = str(tmp_path / "SERVE.json")
+    tel = str(tmp_path / "telemetry-serve")
+    rc = serve_cli.main([
+        "--modelclass", "TransformerLM",
+        *[a for s in tiny for a in ("--set", s)],
+        "--checkpoint-dir", ckpt, "--requests", "4", "--arrival-rate", "50",
+        "--prompt-len", "4", "--max-new-tokens", "4",
+        "--max-batch", "2", "--block-size", "4",
+        "--total-deadline-ms", "30000", "--shed", "--drain-s", "20",
+        "--rollout-watch", "--rollout-probation-s", "60",
+        "--telemetry-dir", tel, "--out", out, "--quiet",
+    ])
+    assert rc == 0
+    art = json.load(open(out))
+    # the fields step 6b's procedure reads
+    states = art["terminal_states"]
+    assert set(states) <= set(TERMINAL_STATES)
+    assert sum(states.values()) == art["requests"] == 4
+    assert states.get("done") == 4  # nothing shed/expired at this load
+    roll = art["rollout"]
+    assert roll["rollouts"] == roll["rollbacks"] == roll["refused"] == 0
+    assert roll["serving_epoch"] == 0
+    assert art["attempt"] == 1 and art["drained"] is False
+    # the durable per-request log a supervised restart dedups against
+    assert terminal_rids(os.path.join(tel, "REQUESTS.jsonl")) == {0, 1, 2, 3}
+
+
 def test_runbook_checkpoint_scrubber_command(tmp_path, capsys):
     """The RUNBOOK's checkpoint-hygiene step (ISSUE 5): the exact
     `python -m theanompi_tpu.utils.checkpoint --verify DIR` scrubber CLI

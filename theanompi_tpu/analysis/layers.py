@@ -90,10 +90,13 @@ LAYER_DAG: tuple[tuple[str, tuple[str, ...], tuple[str, ...]], ...] = (
                    ("codes", "telemetry", "resilience", "utils_base")),
     # serving is a read-only consumer: kernels (shared int8 wire format),
     # verified checkpoint loads, telemetry, the launcher's config surface
-    # — NEVER exchange/training (see the any-depth wall below)
+    # — NEVER exchange/training (see the any-depth wall below).
+    # "resilience" admitted in ISSUE 14 for the FAULT GRAMMAR + exit
+    # codes only; the supervisor/sentinel/watchdog machinery stays
+    # walled off any-depth below
     ("serving",    (f"{PKG}.serving",),
                    ("codes", "telemetry", "kernels", "utils_base", "ckpt",
-                    "tooling")),
+                    "tooling", "resilience")),
     ("analysis",   (f"{PKG}.analysis",),
                    ("codes", "native", "telemetry", "resilience", "mesh",
                     "kernels", "sharding", "ops", "utils_base", "exchange",
@@ -115,7 +118,13 @@ SERVING_FORBIDDEN_IMPORTS = (
     f"{PKG}.resilience.supervisor",
     f"{PKG}.resilience.sentinel",
     f"{PKG}.resilience.watchdog",
-    f"{PKG}.resilience.faults",
+    # NOTE (ISSUE 14): ``resilience.faults`` was deliberately REMOVED from
+    # this wall — the serving chaos sites (serve:raise/stall/
+    # rollout_corrupt) fire inside the serving process, and the fault
+    # grammar is leaf machinery (stdlib-only), not training machinery.
+    # The supervisor half stays forbidden: ``tmserve --supervise`` reaches
+    # ``run_job`` through ``resilience/replica.py`` (a resilience-layer
+    # module) via a lazy import, mirroring the launcher seam.
     # serving ⊥ fleet (ISSUE 11): a replica must not reach into the
     # scheduler that may be preempting it — coordination flows the other
     # way, through processes and exit codes

@@ -46,13 +46,28 @@ specs separated by ``;`` or ``,``)::
                          the main state file in half after commit — the
                          next load must recover from the previous
                          generation, not crash the scheduler
+    serve:raise@6        ISSUE 14: raise FaultInjected at serving decode
+                         step 6 (under ``tmserve --supervise`` the
+                         replica supervisor classifies a crash and
+                         restarts; the REQUESTS.jsonl terminal log makes
+                         the restart skip already-answered requests)
+    serve:stall@6        decode step 6 hangs for THEANOMPI_SERVE_STALL_S
+                         seconds (default 2.0) — exercises the hang/SLO
+                         health detectors against a wedged decode
+    serve:rollout_corrupt@0    bit-flip the 1st rollout CANDIDATE's .npz
+                         before the watcher verifies it — the rollout
+                         must refuse the candidate and keep serving the
+                         old weights (candidate ordinal, not decode step)
 
 ``INDEX`` is the global step for ``step``, the batch ordinal for
 ``prefetch``, the per-process read ordinal for ``data`` (every
 ``read_with_retry`` call draws the next ordinal; ``set_data_hooks``
 resets the counter), the epoch for ``checkpoint``, the supervisor
-attempt for ``reshard``, and the launch/persist ordinal for ``fleet``.
-The optional ``ATTEMPT``
+attempt for ``reshard``, the launch/persist ordinal for ``fleet``, and
+for ``serve`` the decode-step ordinal (``raise``/``stall``) or the
+rollout-candidate ordinal (``rollout_corrupt`` — the two hooks count
+different things, so the scheduler and the rollout watcher both narrow
+their ``fire`` calls by action).  The optional ``ATTEMPT``
 gates a spec to one supervisor attempt (``THEANOMPI_ATTEMPT``, which the
 supervisor sets; unsupervised processes count as attempt 1) — a ``kill``
 spec under supervision should carry ``@1`` so the restarted attempt does
@@ -86,6 +101,7 @@ SITES = {
     "checkpoint": ("fail", "truncate", "bitflip", "manifest_drop"),
     "reshard": ("fail",),
     "fleet": ("kill_job", "ledger_torn_write"),
+    "serve": ("raise", "stall", "rollout_corrupt"),
 }
 
 

@@ -1,0 +1,74 @@
+"""Supervised serving replicas (ISSUE 14): tmserve through run_job.
+
+``tmserve --supervise`` must reach the PR 10 supervisor seam, but the
+serving⊥training wall forbids ``serving/`` importing
+``resilience.supervisor`` at any depth — so the supervision half lives
+HERE, in the resilience layer (where the in-layer supervisor import is
+legal), and ``serving/cli.py`` reaches it through one lazy import,
+mirroring the launcher's ``_supervise`` seam.
+
+Deliberately stdlib-only and serving-import-free (the resilience leaf
+wall): the child is ``python -m theanompi_tpu.serving`` as a SUBPROCESS —
+this module never touches engine/scheduler machinery.
+
+Semantics differ from the training supervisor in one way: ``resume_args``
+is EMPTY.  A restarted replica has nothing to "--resume" — it re-derives
+its request stream from the seed and skips the ids its REQUESTS.jsonl
+already recorded terminal (see :mod:`theanompi_tpu.serving.lifecycle`).
+Graceful drain composes for free: the supervisor forwards SIGTERM to the
+child, the child drains within ``--drain-s`` and exits 0, and the
+``cause == "clean"`` check in the attempt loop classifies the episode
+clean — no restart, no crash count.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from theanompi_tpu.resilience.supervisor import JobResult, run_job
+
+#: tmserve flags the supervisor consumes (never forwarded to the child);
+#: value = operand count, same stripping grammar as the launcher's
+SERVE_SUPERVISOR_FLAGS = {"--supervise": 0, "--max-restarts": 1,
+                          "--backoff-base": 1}
+
+
+def strip_supervision_args(argv: list[str]) -> list[str]:
+    out, i = [], 0
+    while i < len(argv):
+        key = argv[i].split("=", 1)[0]
+        if key in SERVE_SUPERVISOR_FLAGS:
+            i += 1
+            if "=" not in argv[i - 1]:
+                i += SERVE_SUPERVISOR_FLAGS[key]
+            continue
+        out.append(argv[i])
+        i += 1
+    return out
+
+
+def serve_supervised(argv: list[str], *, max_restarts: int = 3,
+                     backoff_base: float = 1.0,
+                     telemetry_dir: str | None = None,
+                     seed: int = 0) -> int:
+    """Run ``tmserve`` as a supervised child replica; -> final exit code.
+
+    The per-attempt resilience.json lands in the telemetry dir (or the
+    cwd) — NEVER in ``--checkpoint-dir``, which serving only ever reads
+    (a live trainer may own it; the read-only contract holds).
+    """
+    base = telemetry_dir or "."
+    os.makedirs(base, exist_ok=True)
+    child = ([sys.executable, "-m", "theanompi_tpu.serving"]
+             + strip_supervision_args(argv))
+    result: JobResult = run_job(
+        child,
+        max_restarts=max_restarts,
+        backoff_base=backoff_base,
+        resilience_path=os.path.join(base, "resilience.json"),
+        telemetry_dir=telemetry_dir,
+        seed=seed,
+        resume_args=(),  # replicas re-derive state; tmserve has no --resume
+    )
+    return result.exit_code

@@ -15,20 +15,38 @@ read-only through the PR 5 verified chain and serve through:
 - :mod:`theanompi_tpu.serving.cli` — the ``tmserve`` entry point
   (synthetic open-loop traffic, SERVE.json report).
 
+The resilience tier (ISSUE 14) adds:
+
+- typed request terminal states (``done|expired|shed|failed``) with
+  per-request TTFT/total deadlines, admission-time load shedding, and a
+  livelock guard (all in the scheduler);
+- :mod:`theanompi_tpu.serving.lifecycle` — the durable REQUESTS.jsonl
+  terminal-state log a supervised restart dedups against;
+- :mod:`theanompi_tpu.serving.rollout` — verified live weight rollout
+  with health-probation auto-rollback;
+- graceful drain on SIGTERM and ``tmserve --supervise`` (the supervision
+  half lives in :mod:`theanompi_tpu.resilience.replica`, across the wall).
+
 Import discipline (lint-enforced, ``tests/test_lint_resilience.py``): this
 package never imports the training side — no trainer, exchanger, optimizer
 or supervisor — and reads checkpoint bytes only through the verified
-loader.
+loader.  ISSUE 14 deliberately relaxed the wall for exactly two resilience
+leaves: the fault grammar (``resilience.faults``) and the exit codes;
+``resilience.supervisor`` stays forbidden at any depth (``--supervise``
+reaches it through one lazy import of ``resilience.replica``).
 """
 
 from theanompi_tpu.serving.engine import InferenceEngine, sample_tokens
 from theanompi_tpu.serving.kv_cache import BlockPool, PagedKVCache, blocks_for
+from theanompi_tpu.serving.lifecycle import RequestLog, terminal_rids
 from theanompi_tpu.serving.quant import (
     QuantizedTensor,
     dequantize_tree,
     quantize_tree,
 )
+from theanompi_tpu.serving.rollout import RolloutManager, newest_manifest_epoch
 from theanompi_tpu.serving.scheduler import (
+    TERMINAL_STATES,
     Request,
     Scheduler,
     run_open_loop,
@@ -37,6 +55,8 @@ from theanompi_tpu.serving.scheduler import (
 
 __all__ = [
     "BlockPool", "InferenceEngine", "PagedKVCache", "QuantizedTensor",
-    "Request", "Scheduler", "blocks_for", "dequantize_tree",
-    "quantize_tree", "run_open_loop", "sample_tokens", "serve_report",
+    "Request", "RequestLog", "RolloutManager", "Scheduler",
+    "TERMINAL_STATES", "blocks_for", "dequantize_tree",
+    "newest_manifest_epoch", "quantize_tree", "run_open_loop",
+    "sample_tokens", "serve_report", "terminal_rids",
 ]

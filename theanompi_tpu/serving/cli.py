@@ -82,6 +82,47 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--temperature", type=float, default=0.0,
                    help="0 = greedy; >0 samples under explicit PRNG keys")
     p.add_argument("--seed", type=int, default=0)
+    # -- resilience (ISSUE 14) ---------------------------------------------
+    p.add_argument("--ttft-deadline-ms", type=float, default=None,
+                   help="per-request time-to-first-token deadline; a "
+                   "request past it EXPIRES (typed terminal state) "
+                   "instead of occupying a slot")
+    p.add_argument("--total-deadline-ms", type=float, default=None,
+                   help="per-request end-to-end deadline (expire beyond)")
+    p.add_argument("--shed", action="store_true",
+                   help="admission-time load shedding: refuse (terminal "
+                   "state 'shed') a deadline-carrying request the queue "
+                   "backlog provably cannot meet at the recent token rate")
+    p.add_argument("--drain-s", type=float, default=5.0,
+                   help="graceful-drain budget: on SIGTERM stop admitting, "
+                   "finish or expire in-flight requests within this many "
+                   "seconds, then exit clean (0)")
+    p.add_argument("--requests-log", default=None,
+                   help="append one JSONL line per terminal request here "
+                   "(default <telemetry-dir>/REQUESTS.jsonl when telemetry "
+                   "is on); a restarted --supervise attempt reads it back "
+                   "and skips already-answered ids")
+    p.add_argument("--supervise", action="store_true",
+                   help="run the replica as a supervised child through the "
+                   "shared run_job seam: crash classification, bounded "
+                   "backoff restarts, per-attempt resilience.json "
+                   "(written to --telemetry-dir, never the read-only "
+                   "--checkpoint-dir)")
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument("--backoff-base", type=float, default=1.0)
+    # -- live weight rollout (ISSUE 14) ------------------------------------
+    p.add_argument("--rollout-watch", action="store_true",
+                   help="watch --checkpoint-dir and hot-swap newly "
+                   "VERIFIED checkpoints between scheduler steps (active "
+                   "requests recompute under the new weights — none are "
+                   "dropped); corrupt/half-published candidates are "
+                   "refused and re-polled, never quarantined")
+    p.add_argument("--rollout-poll-s", type=float, default=0.5,
+                   help="checkpoint-dir poll interval (listdir only)")
+    p.add_argument("--rollout-probation-s", type=float, default=10.0,
+                   help="after a swap, auto-roll back to the previous "
+                   "weights if the health monitor's SLO/throughput "
+                   "verdict turns critical within this window")
     # -- output ------------------------------------------------------------
     p.add_argument("--telemetry-dir", default=None,
                    help="serve.prefill/serve.decode spans + serve.* "
@@ -108,9 +149,14 @@ def _error_line(phase: str, e: BaseException) -> None:
 
 def synthetic_requests(n: int, vocab: int, prompt_len: int,
                        max_new_tokens: int, rate: float, seed: int,
-                       temperature: float = 0.0):
+                       temperature: float = 0.0,
+                       ttft_deadline_ms: float | None = None,
+                       total_deadline_ms: float | None = None):
     """Seeded open-loop request stream: uniform-random prompts, Poisson
-    arrivals at ``rate`` req/s (``rate=0`` = one burst at t=0)."""
+    arrivals at ``rate`` req/s (``rate=0`` = one burst at t=0).  The
+    stream is a pure function of its arguments — a restarted supervised
+    replica regenerates the identical stream and filters out the ids its
+    REQUESTS.jsonl already answered."""
     import numpy as np
 
     from theanompi_tpu.serving.scheduler import Request
@@ -127,15 +173,33 @@ def synthetic_requests(n: int, vocab: int, prompt_len: int,
             max_new_tokens=max_new_tokens,
             temperature=temperature,
             arrival_s=t if rate > 0 else 0.0,
+            ttft_deadline_ms=ttft_deadline_ms,
+            total_deadline_ms=total_deadline_ms,
         ))
     return out
 
 
 def serve(args) -> dict:
-    """Build model + engine + scheduler, run the synthetic load; -> report."""
-    import importlib
+    """Build model + engine + scheduler, run the synthetic load; -> report.
 
+    The resilience tier (ISSUE 14) hangs off this one loop: SIGTERM flips
+    a drain event the open-loop driver polls (stop admitting, finish or
+    expire in-flight within ``--drain-s``, exit clean); every terminal
+    request appends to REQUESTS.jsonl so a supervised restart can skip
+    already-answered ids; ``--rollout-watch`` polls the checkpoint dir
+    between steps and hot-swaps verified checkpoints.
+    """
+    import importlib
+    import signal
+    import threading
+
+    from theanompi_tpu.resilience.faults import FaultPlan
     from theanompi_tpu.serving.engine import InferenceEngine
+    from theanompi_tpu.serving.lifecycle import (
+        REQUESTS_LOG,
+        RequestLog,
+        terminal_rids,
+    )
     from theanompi_tpu.serving.scheduler import (
         Scheduler,
         run_open_loop,
@@ -159,6 +223,9 @@ def serve(args) -> dict:
                 f"serve random inits when a directory was given)")
         epoch, _it, trees = restored
         params = trees["params"]
+    if args.rollout_watch and not args.checkpoint_dir:
+        raise ValueError("--rollout-watch needs --checkpoint-dir (there is "
+                         "nothing to watch)")
 
     telemetry = None
     if args.telemetry_dir:
@@ -172,18 +239,79 @@ def serve(args) -> dict:
         telemetry = Telemetry(args.telemetry_dir, health=health,
                               flight_recorder=256)
 
+    fault_plan = FaultPlan.from_spec(None)  # THEANOMPI_FAULT_PLAN env
+    try:
+        attempt = int(os.environ.get("THEANOMPI_ATTEMPT", "1"))
+    except ValueError:
+        attempt = 1
     engine = InferenceEngine(
         model, params, block_size=args.block_size,
         num_blocks=args.num_blocks, max_batch=args.max_batch,
         quantize_int8=args.quantize_int8, top_k=args.top_k, seed=args.seed)
-    sched = Scheduler(engine, telemetry=telemetry)
+    sched = Scheduler(engine, telemetry=telemetry, shed=args.shed,
+                      fault_plan=fault_plan)
     reqs = synthetic_requests(
         args.requests, model.data.vocab, args.prompt_len,
         args.max_new_tokens, args.arrival_rate, args.seed,
-        args.temperature)
-    results, wall_s = run_open_loop(sched, reqs)
+        args.temperature, ttft_deadline_ms=args.ttft_deadline_ms,
+        total_deadline_ms=args.total_deadline_ms)
+
+    # -- durable terminal-state log + restart dedup (ISSUE 14) -------------
+    log_path = args.requests_log or (
+        os.path.join(args.telemetry_dir, REQUESTS_LOG)
+        if args.telemetry_dir else None)
+    req_log = None
+    n_skipped = 0
+    if log_path:
+        answered = terminal_rids(log_path)
+        if answered:
+            before = len(reqs)
+            reqs = [r for r in reqs if r.rid not in answered]
+            n_skipped = before - len(reqs)
+        req_log = RequestLog(log_path, attempt=attempt)
+
+    # -- graceful drain: SIGTERM -> drain within --drain-s, exit clean -----
+    drain_ev = threading.Event()
+    prev_term = None
+    if threading.current_thread() is threading.main_thread():
+        prev_term = signal.signal(signal.SIGTERM,
+                                  lambda _sig, _frm: drain_ev.set())
+
+    # -- verified live rollout watcher -------------------------------------
+    rollout = None
+    if args.rollout_watch:
+        from theanompi_tpu.serving.rollout import RolloutManager
+
+        rollout = RolloutManager(
+            engine, args.checkpoint_dir, {"params": params}, model=model,
+            verify=args.serve_verify, current_epoch=epoch,
+            poll_s=args.rollout_poll_s,
+            probation_s=args.rollout_probation_s,
+            telemetry=telemetry, fault_plan=fault_plan)
+
+    try:
+        results, wall_s = run_open_loop(
+            sched, reqs, drain=drain_ev.is_set, drain_s=args.drain_s,
+            on_terminal=req_log.record if req_log else None,
+            between_steps=rollout.poll if rollout else None)
+    finally:
+        if prev_term is not None:
+            signal.signal(signal.SIGTERM, prev_term)
+        if req_log is not None:
+            req_log.close()
     report = serve_report(results, wall_s, sched)
-    report["checkpoint_epoch"] = epoch
+    report["checkpoint_epoch"] = (rollout.current_epoch if rollout
+                                  else epoch)
+    report["attempt"] = attempt
+    if n_skipped:
+        report["skipped_already_answered"] = n_skipped
+    if log_path:
+        report["requests_log"] = log_path
+    if rollout is not None:
+        report["rollout"] = {"rollouts": rollout.n_rollouts,
+                             "rollbacks": rollout.n_rollbacks,
+                             "refused": rollout.n_refused,
+                             "serving_epoch": rollout.current_epoch}
     if engine.quant_stats:
         report["quantization"] = engine.quant_stats
     if telemetry is not None:
@@ -209,6 +337,21 @@ def main(argv: list[str] | None = None) -> int:
     except SystemExit as e:
         # argparse exits 2 on bad flags — keep its contract
         return int(e.code or 0)
+
+    if args.supervise:
+        # the supervision half lives across the wall in the resilience
+        # layer (serving may never import resilience.supervisor); one lazy
+        # import reaches it, mirroring the launcher's _supervise seam
+        if os.environ.get("THEANOMPI_SUPERVISED"):
+            _error_line("config", RuntimeError(
+                "--supervise inside a supervised child (recursion guard)"))
+            return EXIT_CONFIG
+        from theanompi_tpu.resilience.replica import serve_supervised
+
+        return serve_supervised(
+            argv, max_restarts=args.max_restarts,
+            backoff_base=args.backoff_base,
+            telemetry_dir=args.telemetry_dir, seed=args.seed)
 
     from theanompi_tpu.utils.checkpoint import (
         CheckpointCorruptError,

@@ -82,9 +82,14 @@ class InferenceEngine:
         self.top_k = int(top_k)
         self._base_key = jax.random.PRNGKey(seed)
         self.quant_stats = None
+        # kept for swap_params: a live weight rollout must re-quantize the
+        # incoming tree EXACTLY as __init__ did (same key, same chunking)
+        self._quantize_int8 = bool(quantize_int8)
+        self._quant_key = jax.random.PRNGKey(seed ^ 0x51)
+        self._quant_chunk = int(quant_chunk)
         if quantize_int8:
             params, self.quant_stats = quantize_tree(
-                params, jax.random.PRNGKey(seed ^ 0x51), quant_chunk)
+                params, self._quant_key, quant_chunk)
         self.params = params
         heads, dim = cfg["heads"], cfg["dim"]
         cache = PagedKVCache.create(
@@ -102,6 +107,31 @@ class InferenceEngine:
     @property
     def quantized(self) -> bool:
         return is_quantized_tree(self.params)
+
+    def swap_params(self, params):
+        """Hot-swap the serving weights (ISSUE 14 live rollout); -> the
+        PREVIOUS engine-format param tree (the rollback token: pass it
+        back to :meth:`restore_params` to undo the swap exactly).
+
+        The new tree re-quantizes with the key/chunking ``__init__`` used,
+        so it lands in the same engine format; identical shapes mean the
+        jitted prefill/decode programs are reused — no recompile, and the
+        swap is a host-side pointer update between scheduler steps.  The
+        KV cache is NOT touched: the caller (the rollout watcher) preempts
+        active sequences first, since their cache was computed under the
+        old weights.
+        """
+        prev = self.params
+        if self._quantize_int8:
+            params, self.quant_stats = quantize_tree(
+                params, self._quant_key, self._quant_chunk)
+        self.params = params
+        return prev
+
+    def restore_params(self, engine_params) -> None:
+        """Reinstall a tree previously returned by :meth:`swap_params`
+        (already in engine format — never re-quantized)."""
+        self.params = engine_params
 
     # -- compiled bodies -----------------------------------------------------
     def _decode_impl(self, params, k, v, tables, lengths, tokens, temps,

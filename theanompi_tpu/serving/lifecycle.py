@@ -1,0 +1,68 @@
+"""Durable request terminal-state log (ISSUE 14).
+
+A supervised serving replica can crash and restart mid-drive; the
+in-memory results dict dies with it.  ``REQUESTS.jsonl`` is the durable
+witness that every request id reached exactly one terminal state across
+ALL attempts: the replica appends one JSON line the moment a request
+turns terminal (``done|expired|shed|failed``), and a restarted attempt
+reads the log back to skip already-answered ids instead of re-serving
+them — the "zero requests lost" half of the chaos acceptance test.
+
+Plain append-mode JSONL, flushed per line: a SIGKILL can lose at most the
+in-flight line, and a lost line only means the restarted attempt serves
+that request again (idempotent for the synthetic open-loop driver, whose
+request streams are seed-deterministic).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+REQUESTS_LOG = "REQUESTS.jsonl"
+
+
+class RequestLog:
+    """Append-only terminal-state writer for one serving attempt."""
+
+    def __init__(self, path: str, attempt: int = 1):
+        self.path = path
+        self.attempt = attempt
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._f = open(path, "a")
+
+    def record(self, req) -> None:
+        """One line per terminal request: rid, state, reason, tokens."""
+        json.dump({"rid": req.rid, "state": req.state,
+                   "reason": req.reason,
+                   "n_generated": len(req.generated),
+                   "attempt": self.attempt}, self._f)
+        self._f.write("\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def terminal_rids(path: str) -> set[int]:
+    """Request ids already recorded terminal (any attempt); a restarted
+    replica excludes them from its regenerated synthetic stream.  Partial
+    trailing lines (the SIGKILL race) are skipped, not fatal."""
+    rids: set[int] = set()
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn final line from a killed attempt
+                if isinstance(rec, dict) and "rid" in rec:
+                    rids.add(int(rec["rid"]))
+    except OSError:
+        return set()
+    return rids

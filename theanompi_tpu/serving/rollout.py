@@ -1,0 +1,227 @@
+"""Verified live weight rollout with auto-rollback (ISSUE 14).
+
+ROADMAP item 3(e): train → serve as one continuous pipeline.  A
+:class:`RolloutManager` watches a checkpoint directory a live trainer may
+still own and hot-swaps newly *verified* checkpoints into the serving
+engine between scheduler steps:
+
+- **discovery** is manifest-name-only (a ``listdir`` — no checkpoint byte
+  is read until a new epoch shows up), so the idle-poll cost is one
+  directory scan;
+- **verification** goes through the PR 5 read-only chain
+  (:func:`theanompi_tpu.utils.checkpoint.load_for_inference`): a corrupt
+  or HALF-PUBLISHED candidate (manifest visible, ``.npz`` mid-replace —
+  the PR 9 known race at its serving edge) simply fails to verify as the
+  newest epoch, which the watcher treats as "not yet published": it
+  stamps one ``serve.rollout_refused`` event, keeps serving the old
+  weights, and re-polls.  It never quarantines, moves, or deletes a live
+  writer's file — ``load_for_inference`` is read-only by contract;
+- **adoption** preempts every active sequence first (their KV cache was
+  computed under the old weights; recompute-preemption replays them
+  exactly, so no request is dropped), then swaps the params — same
+  shapes, so the compiled decode program is reused — and stamps a
+  ``serve.rollout`` event;
+- **probation**: for ``probation_s`` after a swap the watcher reads the
+  PR 12 health monitor's verdicts; an SLO or throughput verdict turning
+  CRITICAL rolls back to the previous weights (``serve.rollback``), and
+  the rolled-back epoch is remembered as bad so it is never re-adopted.
+
+Chaos site ``serve:rollout_corrupt@i`` (action-narrowed: candidate
+ordinal, not decode step) bit-flips the i-th candidate's ``.npz`` before
+verification — the acceptance test's proof that a bad rollout is refused
+while the old weights keep serving.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from theanompi_tpu.resilience.faults import FaultPlan
+from theanompi_tpu.telemetry.metrics import SERVE_ROLLOUT_INSTANTS
+from theanompi_tpu.utils.checkpoint import (
+    CheckpointCorruptError,
+    load_for_inference,
+)
+
+_INST_ROLLOUT, _INST_REFUSED, _INST_ROLLBACK = SERVE_ROLLOUT_INSTANTS
+
+#: health detectors whose CRITICAL verdict triggers the probation rollback
+ROLLBACK_DETECTORS = ("slo", "throughput")
+
+
+def newest_manifest_epoch(directory: str) -> int | None:
+    """Highest ``ckpt_eNNNN.manifest.json`` epoch by FILENAME only — no
+    file content is read, so polling a live writer's directory is free of
+    torn-read hazards.  None when the directory has no manifests."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    best = None
+    for f in names:
+        if not (f.startswith("ckpt_e") and f.endswith(".manifest.json")):
+            continue
+        try:
+            ep = int(f[len("ckpt_e"):-len(".manifest.json")])
+        except ValueError:
+            continue
+        best = ep if best is None or ep > best else best
+    return best
+
+
+class RolloutManager:
+    """Between-steps checkpoint watcher for one engine + scheduler.
+
+    ``health_verdicts``: zero-arg callable returning the current verdict
+    dicts (``[{"detector", "severity", ...}]``); defaults to the owning
+    telemetry's in-process :class:`HealthMonitor`.  Injectable so tests
+    drive the probation window without a live monitor.
+    """
+
+    def __init__(self, engine, checkpoint_dir: str, templates: dict, *,
+                 model=None, verify: str = "fast",
+                 current_epoch: int | None = None,
+                 poll_s: float = 0.5, probation_s: float = 10.0,
+                 telemetry=None, health_verdicts=None,
+                 fault_plan: FaultPlan | None = None,
+                 clock=time.perf_counter):
+        self.engine = engine
+        self.checkpoint_dir = checkpoint_dir
+        self.templates = templates
+        self.model = model
+        self.verify = verify
+        self.poll_s = float(poll_s)
+        self.probation_s = float(probation_s)
+        self.telemetry = telemetry
+        self._health_verdicts = health_verdicts
+        self.fault_plan = fault_plan
+        self._clock = clock
+        self.current_epoch = -1 if current_epoch is None else current_epoch
+        self._next_poll = 0.0
+        self._prev: tuple[object, int] | None = None  # (engine params, epoch)
+        self._probation_until: float | None = None
+        self._bad_epochs: set[int] = set()
+        self._refused: set[int] = set()
+        self._candidate_ordinals: dict[int, int] = {}
+        self.n_rollouts = 0
+        self.n_rollbacks = 0
+        self.n_refused = 0
+
+    # -- helpers -------------------------------------------------------------
+    def _emit(self, name: str, **fields) -> None:
+        if self.telemetry is not None:
+            self.telemetry.instant(name, **fields)
+
+    def _verdicts(self) -> list[dict]:
+        if self._health_verdicts is not None:
+            return list(self._health_verdicts() or ())
+        mon = getattr(self.telemetry, "health", None)
+        return mon.verdicts() if mon is not None else []
+
+    def _maybe_corrupt_candidate(self, epoch: int) -> None:
+        """serve:rollout_corrupt chaos site: bit-flip the candidate's
+        ``.npz`` mid-file before verification (candidate ordinal — each
+        distinct epoch considered draws the next ordinal)."""
+        if self.fault_plan is None:
+            return
+        if epoch not in self._candidate_ordinals:
+            self._candidate_ordinals[epoch] = len(self._candidate_ordinals)
+        ordinal = self._candidate_ordinals[epoch]
+        if not self.fault_plan.fire("serve", ordinal, "rollout_corrupt"):
+            return
+        npz = os.path.join(self.checkpoint_dir, f"ckpt_e{epoch:04d}.npz")
+        try:
+            with open(npz, "r+b") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(size // 2)
+                b = f.read(1)
+                f.seek(size // 2)
+                f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+        except OSError:
+            pass  # lint: swallow-ok — a chaos hook must not crash serving
+
+    # -- the between-steps poll ----------------------------------------------
+    def poll(self, scheduler) -> str | None:
+        """Run between scheduler steps; -> "rollout" | "rollback" |
+        "refused" | None for this pass (tests key on it)."""
+        now = self._clock()
+        outcome = self._check_probation(scheduler, now)
+        if outcome:
+            return outcome
+        if now < self._next_poll:
+            return None
+        self._next_poll = now + self.poll_s
+        candidate = newest_manifest_epoch(self.checkpoint_dir)
+        if (candidate is None or candidate <= self.current_epoch
+                or candidate in self._bad_epochs):
+            return None
+        self._maybe_corrupt_candidate(candidate)
+        try:
+            restored = load_for_inference(
+                self.checkpoint_dir, self.templates, verify=self.verify,
+                model=self.model)
+        except CheckpointCorruptError as e:
+            # the WHOLE chain failed to verify — nothing newer to adopt;
+            # keep serving the weights already loaded and re-poll
+            return self._refuse(candidate, f"chain unverifiable: {e}")
+        if restored is None:
+            return self._refuse(candidate, "no verifiable checkpoint yet")
+        epoch, _it, trees = restored
+        if epoch <= self.current_epoch or epoch in self._bad_epochs:
+            # the chain stepped BACK over the candidate: its .npz is
+            # corrupt or mid-replace (half-published) — not yet published
+            # as far as serving is concerned; never quarantine, re-poll
+            return self._refuse(candidate, "candidate did not verify "
+                                "(corrupt or half-published)")
+        self._adopt(scheduler, epoch, trees)
+        return "rollout"
+
+    def _refuse(self, epoch: int, reason: str) -> str:
+        if epoch not in self._refused:  # one event per candidate, not
+            self._refused.add(epoch)    # one per poll
+            self.n_refused += 1
+            self._emit(_INST_REFUSED, epoch=epoch, reason=reason)
+        return "refused"
+
+    def _adopt(self, scheduler, epoch: int, trees: dict) -> None:
+        preempted = scheduler.preempt_all()
+        prev_params = self.engine.swap_params(trees["params"])
+        self._prev = (prev_params, self.current_epoch)
+        from_epoch = self.current_epoch
+        self.current_epoch = epoch
+        self._refused.discard(epoch)
+        self._probation_until = self._clock() + self.probation_s
+        self.n_rollouts += 1
+        self._emit(_INST_ROLLOUT, from_epoch=from_epoch, to_epoch=epoch,
+                   preempted=preempted)
+
+    def _check_probation(self, scheduler, now: float) -> str | None:
+        if self._probation_until is None:
+            return None
+        if now >= self._probation_until:
+            # probation survived: the swap is committed, the old weights
+            # are no longer a rollback target
+            self._probation_until = None
+            self._prev = None
+            return None
+        critical = next(
+            (v for v in self._verdicts()
+             if v.get("detector") in ROLLBACK_DETECTORS
+             and v.get("severity") == "critical"), None)
+        if critical is None or self._prev is None:
+            return None
+        prev_params, prev_epoch = self._prev
+        preempted = scheduler.preempt_all()
+        self.engine.restore_params(prev_params)
+        bad = self.current_epoch
+        self._bad_epochs.add(bad)
+        self.current_epoch = prev_epoch
+        self._prev = None
+        self._probation_until = None
+        self.n_rollbacks += 1
+        self._emit(_INST_ROLLBACK, from_epoch=bad, to_epoch=prev_epoch,
+                   detector=critical.get("detector"),
+                   reason=critical.get("reason"), preempted=preempted)
+        return "rollback"

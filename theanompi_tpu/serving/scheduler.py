@@ -17,6 +17,22 @@ sampling keys derive from ``(request id, position)`` only
 (:mod:`theanompi_tpu.serving.engine`), the replayed sequence continues
 exactly where it left off — greedy or sampled.
 
+Request lifecycle (ISSUE 14): every request ends in exactly one typed
+terminal state —
+
+- ``done``     — generation completed (max tokens or EOS);
+- ``expired``  — a per-request deadline (``ttft_deadline_ms`` before the
+  first token, ``total_deadline_ms`` overall) passed; checked at the queue
+  front BEFORE a prefill is burned (a preempted-and-requeued request past
+  its deadline expires immediately) and between scheduler steps for both
+  queued and active requests;
+- ``shed``     — refused at admission: load shedding (the queue's backlog
+  at the recently measured token rate cannot meet the request's deadline)
+  or a graceful drain in progress;
+- ``failed``   — the livelock guard: a request that can never fit the KV
+  pool is refused with a typed terminal state instead of crashing the
+  server or preempting forever.
+
 All telemetry flows through the names registered in
 :mod:`theanompi_tpu.telemetry.metrics` (``SERVE_*``); latency percentiles
 are also tracked host-side so the SERVE report works with telemetry off.
@@ -25,17 +41,21 @@ are also tracked host-side so the SERVE report works with telemetry off.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from collections import deque
 from dataclasses import field
 
 import numpy as np
 
+from theanompi_tpu.resilience.faults import FaultInjected, FaultPlan
 from theanompi_tpu.serving.kv_cache import BlockPool, PagedKVCache, blocks_for
 from theanompi_tpu.telemetry.metrics import (  # registered names (ISSUE 6)
     SERVE_COUNTERS,
     SERVE_HISTOGRAMS,
     SERVE_INSTANTS,
+    SERVE_LIFECYCLE_COUNTERS,
+    SERVE_LIFECYCLE_INSTANTS,
     SERVE_SPANS,
 )
 
@@ -43,34 +63,58 @@ _SPAN_PREFILL, _SPAN_DECODE = SERVE_SPANS
 _INST_ADMIT, _INST_PREEMPT, _INST_FINISH = SERVE_INSTANTS
 _HIST_TOKEN_MS, _HIST_TTFT_MS = SERVE_HISTOGRAMS
 _CNT_TOKENS, _CNT_PREEMPTIONS, _CNT_REQUESTS = SERVE_COUNTERS
+_INST_EXPIRE, _INST_SHED, _INST_FAIL, _INST_DRAIN = SERVE_LIFECYCLE_INSTANTS
+_CNT_EXPIRED, _CNT_SHED, _CNT_FAILED = SERVE_LIFECYCLE_COUNTERS
+
+#: every request ends in exactly one of these (ISSUE 14)
+TERMINAL_STATES = ("done", "expired", "shed", "failed")
 
 
 @dataclasses.dataclass
 class Request:
     """One generation request.  ``arrival_s`` is the open-loop arrival
     offset (seconds from traffic start) — the driver submits the request
-    when the clock passes it, regardless of server state (open loop)."""
+    when the clock passes it, regardless of server state (open loop).
+    Deadlines are milliseconds from ``t_submit`` (None = no deadline)."""
 
     rid: int
     prompt: list[int]
     max_new_tokens: int
     temperature: float = 0.0
     arrival_s: float = 0.0
+    ttft_deadline_ms: float | None = None
+    total_deadline_ms: float | None = None
     # -- filled in by the scheduler -----------------------------------------
+    state: str = "queued"       # queued | active | done|expired|shed|failed
+    reason: str | None = None   # why a non-done terminal state was reached
     generated: list[int] = field(default_factory=list)
     n_preemptions: int = 0
     t_submit: float | None = None
     t_first_token: float | None = None
     t_done: float | None = None
 
+    @property
+    def terminal(self) -> bool:
+        return self.state in TERMINAL_STATES
+
 
 class Scheduler:
-    """Continuous-batching scheduler over one :class:`InferenceEngine`."""
+    """Continuous-batching scheduler over one :class:`InferenceEngine`.
 
-    def __init__(self, engine, telemetry=None, eos_token: int | None = None):
+    ``shed=True`` enables admission-time load shedding for requests that
+    carry a deadline; ``fault_plan`` arms the ``serve:raise``/
+    ``serve:stall`` chaos sites at decode-step ordinals (constructor-only
+    here — the CLI threads the ``THEANOMPI_FAULT_PLAN`` env through).
+    """
+
+    def __init__(self, engine, telemetry=None, eos_token: int | None = None,
+                 shed: bool = False,
+                 fault_plan: FaultPlan | None = None):
         self.engine = engine
         self.telemetry = telemetry
         self.eos_token = eos_token
+        self.shed = shed
+        self.fault_plan = fault_plan
         self.pool = BlockPool(engine.num_blocks)
         self.queue: deque[Request] = deque()
         b, nb = engine.max_batch, engine.max_blocks_per_seq
@@ -85,6 +129,13 @@ class Scheduler:
         self.token_ms: list[float] = []
         self.ttft_ms: list[float] = []
         self.n_preemptions = 0
+        self.n_expired = 0
+        self.n_shed = 0
+        self.n_failed = 0
+        self.draining = False
+        # recent decode throughput: (host time, tokens emitted that step),
+        # the load-shedding estimator's evidence window
+        self._rate: deque[tuple[float, int]] = deque(maxlen=64)
 
     # -- introspection -------------------------------------------------------
     @property
@@ -95,8 +146,32 @@ class Scheduler:
     def idle(self) -> bool:
         return self.n_active == 0 and not self.queue
 
+    def recent_token_rate(self) -> float | None:
+        """Decoded tokens/sec over the recent window; None until at least
+        4 decode steps spanning a measurable interval exist (shedding
+        never fires on guesswork)."""
+        if len(self._rate) < 4:
+            return None
+        span = self._rate[-1][0] - self._rate[0][0]
+        if span <= 1e-6:
+            return None
+        return sum(n for _, n in self._rate) / span
+
+    def _backlog_tokens(self) -> int:
+        """Tokens the server still owes the queue + active slots."""
+        owed = 0
+        for req in list(self.queue):
+            owed += max(req.max_new_tokens - len(req.generated), 0)
+        for req in self.slots:
+            if req is not None:
+                owed += max(req.max_new_tokens - len(req.generated), 0)
+        return owed
+
     # -- submission ----------------------------------------------------------
-    def submit(self, req: Request) -> None:
+    def submit(self, req: Request) -> bool:
+        """Queue ``req``; -> True when admitted, False when it was SHED
+        (a typed terminal state — load shedding or a drain in progress).
+        Structurally invalid requests still raise ValueError."""
         total = len(req.prompt) + req.max_new_tokens
         if not req.prompt:
             raise ValueError(f"request {req.rid}: empty prompt")
@@ -111,7 +186,34 @@ class Scheduler:
                 f"has {self.pool.num_blocks - 1} — num_blocks too small for "
                 f"even one sequence")
         req.t_submit = time.perf_counter()
+        if self.draining:
+            self.mark_shed(req, "draining")
+            return False
+        if self.shed:
+            est_ms = self._shed_estimate_ms(req)
+            if est_ms is not None:
+                self.mark_shed(
+                    req, f"backlog needs ~{est_ms:.0f}ms at the recent "
+                    f"token rate, past the deadline", est_wait_ms=est_ms)
+                return False
+        req.state = "queued"
         self.queue.append(req)
+        return True
+
+    def _shed_estimate_ms(self, req: Request) -> float | None:
+        """Estimated wait (ms) when it provably exceeds the request's
+        deadline budget, else None (admit).  Deadline-less requests are
+        never shed; neither is anything before the rate is measurable."""
+        budget = min((d for d in (req.ttft_deadline_ms,
+                                  req.total_deadline_ms) if d is not None),
+                     default=None)
+        if budget is None:
+            return None
+        rate = self.recent_token_rate()
+        if rate is None or rate <= 0:
+            return None
+        est_ms = self._backlog_tokens() / rate * 1e3
+        return est_ms if est_ms > budget else None
 
     # -- internals -----------------------------------------------------------
     def _emit(self, name: str, **fields) -> None:
@@ -127,10 +229,15 @@ class Scheduler:
         self._temps[slot] = 0.0
         self._rids[slot] = 0
 
-    def _finish(self, slot: int, finished: list[Request]) -> None:
+    def _evict(self, slot: int) -> Request:
         req = self.slots[slot]
         self.pool.free(self._blocks[slot])
         self._clear_slot(slot)
+        return req
+
+    def _finish(self, slot: int, finished: list[Request]) -> None:
+        req = self._evict(slot)
+        req.state = "done"
         req.t_done = time.perf_counter()
         if self.telemetry is not None:
             self.telemetry.count(_CNT_REQUESTS)
@@ -138,37 +245,149 @@ class Scheduler:
                    generated=len(req.generated))
         finished.append(req)
 
+    def _expire(self, req: Request, which: str, where: str,
+                finished: list[Request]) -> None:
+        """Typed terminal: a deadline passed.  The caller already removed
+        ``req`` from the queue or evicted its slot."""
+        req.state = "expired"
+        req.reason = f"{which} deadline exceeded ({where})"
+        req.t_done = time.perf_counter()
+        self.n_expired += 1
+        if self.telemetry is not None:
+            self.telemetry.count(_CNT_EXPIRED)
+        self._emit(_INST_EXPIRE, request=req.rid, which=which, where=where)
+        finished.append(req)
+
+    def mark_shed(self, req: Request, reason: str,
+                  est_wait_ms: float | None = None) -> None:
+        """Typed terminal: refused at admission (shedding or drain).  The
+        request was never queued — no blocks, no prefill, no tokens."""
+        now = time.perf_counter()
+        if req.t_submit is None:
+            req.t_submit = now
+        req.state = "shed"
+        req.reason = reason
+        req.t_done = now
+        self.n_shed += 1
+        if self.telemetry is not None:
+            self.telemetry.count(_CNT_SHED)
+        fields = {"request": req.rid, "reason": reason}
+        if est_wait_ms is not None:
+            fields["est_wait_ms"] = round(est_wait_ms, 1)
+        self._emit(_INST_SHED, **fields)
+
+    def _fail(self, req: Request, need: int,
+              finished: list[Request]) -> None:
+        """Typed terminal: the livelock guard.  A request whose prefix can
+        never fit the pool is refused — NOT crashed on, NOT preempted
+        around forever (the pre-ISSUE-14 behavior raised RuntimeError and
+        took the whole server down with it)."""
+        req.state = "failed"
+        req.reason = (f"needs {need} KV blocks, pool has "
+                      f"{self.pool.num_blocks - 1} — can never be admitted")
+        req.t_done = time.perf_counter()
+        self.n_failed += 1
+        if self.telemetry is not None:
+            self.telemetry.count(_CNT_FAILED)
+        self._emit(_INST_FAIL, request=req.rid, need_blocks=need,
+                   pool_blocks=self.pool.num_blocks - 1)
+        finished.append(req)
+
+    def _deadline_overrun(self, req: Request,
+                          now: float | None = None) -> str | None:
+        """Which deadline ``req`` has blown ("ttft" | "total"), or None."""
+        if req.t_submit is None:
+            return None
+        now = time.perf_counter() if now is None else now
+        elapsed_ms = (now - req.t_submit) * 1e3
+        if (req.total_deadline_ms is not None
+                and elapsed_ms > req.total_deadline_ms):
+            return "total"
+        if (req.t_first_token is None and req.ttft_deadline_ms is not None
+                and elapsed_ms > req.ttft_deadline_ms):
+            return "ttft"
+        return None
+
+    def _sweep_deadlines(self, finished: list[Request]) -> None:
+        """Between-steps deadline enforcement: expire overrun queued AND
+        active requests (active ones free their blocks — an expired
+        request must stop consuming decode slots immediately)."""
+        now = time.perf_counter()
+        kept: deque[Request] = deque()
+        while self.queue:
+            req = self.queue.popleft()
+            which = self._deadline_overrun(req, now)
+            if which:
+                self._expire(req, which, "queued", finished)
+            else:
+                kept.append(req)
+        self.queue = kept
+        for slot in range(self.engine.max_batch):
+            req = self.slots[slot]
+            if req is None:
+                continue
+            which = self._deadline_overrun(req, now)
+            if which:
+                self._evict(slot)
+                self._expire(req, which, "active", finished)
+
     def _preempt(self, slot: int) -> None:
-        req = self.slots[slot]
-        self.pool.free(self._blocks[slot])
-        self._clear_slot(slot)
+        req = self._evict(slot)
         req.n_preemptions += 1
         self.n_preemptions += 1
+        req.state = "queued"
         if self.telemetry is not None:
             self.telemetry.count(_CNT_PREEMPTIONS)
         self._emit(_INST_PREEMPT, request=req.rid,
                    held_tokens=len(req.prompt) + len(req.generated))
         self.queue.appendleft(req)  # rejoin first: it already holds work
 
+    def preempt_all(self) -> int:
+        """Evict every active request back to the queue front (recompute
+        preemption) — the rollout watcher's weight-swap barrier: the KV
+        cache was computed under the OLD weights, so active sequences
+        re-prefill under the new ones.  -> number preempted."""
+        n = 0
+        for slot in range(self.engine.max_batch):
+            if self.slots[slot] is not None:
+                self._preempt(slot)
+                n += 1
+        return n
+
     def _admit(self, finished: list[Request]) -> None:
         """Prefill queued requests into free slots while blocks last."""
         while self.queue:
+            req = self.queue[0]
+            # deadline check BEFORE any prefill work (ISSUE 14 satellite):
+            # preemption re-queues to the FRONT unconditionally, so a
+            # requeued request past its deadline must expire here, not
+            # burn a recompute-prefill first
+            which = self._deadline_overrun(req)
+            if which:
+                self.queue.popleft()
+                self._expire(req, which, "queued", finished)
+                continue
             try:
                 slot = self.slots.index(None)
             except ValueError:
                 return
-            req = self.queue[0]
             prefix = req.prompt + req.generated
             need = blocks_for(len(prefix), self.engine.block_size)
+            if need > self.pool.num_blocks - 1:
+                # livelock guard: this prefix can NEVER fit, even into an
+                # empty pool — refuse it and keep serving everyone else
+                self.queue.popleft()
+                self._fail(req, need, finished)
+                continue
             row = self.pool.alloc(need)
             if row is None:
                 if self.n_active == 0:
-                    # cannot happen for a submit()-validated request unless
-                    # the pool leaked; fail loudly rather than spin forever
-                    raise RuntimeError(
-                        f"request {req.rid} cannot be admitted into an "
-                        f"EMPTY server ({need} blocks needed, "
-                        f"{self.pool.free_blocks} free)")
+                    # an empty server that still can't allocate means the
+                    # pool leaked: refuse THIS request (typed terminal)
+                    # instead of raising and killing every other request
+                    self.queue.popleft()
+                    self._fail(req, need, finished)
+                    continue
                 return
             self.queue.popleft()
             span = (self.telemetry.span(_SPAN_PREFILL, request=req.rid,
@@ -197,6 +416,7 @@ class Scheduler:
             self._emit(_INST_ADMIT, request=req.rid, slot=slot,
                        prefix=len(prefix), blocks=need,
                        resumed=req.n_preemptions > 0)
+            req.state = "active"
             self.slots[slot] = req
             self._blocks[slot] = row
             self._tables[slot, :] = PagedKVCache.NULL_BLOCK
@@ -238,10 +458,26 @@ class Scheduler:
                     key=lambda s: int(self._lengths[s]))
                 self._preempt(victim)
 
+    def _fire_faults(self) -> None:
+        """serve:raise / serve:stall chaos sites, indexed by decode-step
+        ordinal.  Action-narrowed fires: the rollout watcher counts a
+        DIFFERENT ordinal (candidates) for serve:rollout_corrupt."""
+        if self.fault_plan is None:
+            return
+        if self.fault_plan.fire("serve", self.n_steps, "stall"):
+            time.sleep(float(os.environ.get("THEANOMPI_SERVE_STALL_S",
+                                            "2.0")))
+        if self.fault_plan.fire("serve", self.n_steps, "raise"):
+            raise FaultInjected(
+                f"serve:raise at decode step {self.n_steps}")
+
     def step(self) -> list[Request]:
-        """One scheduler iteration: admit, secure blocks, decode the fixed
-        batch, account the new tokens; -> the requests finished this step."""
+        """One scheduler iteration: enforce deadlines, admit, secure
+        blocks, decode the fixed batch, account the new tokens; -> every
+        request that reached a TERMINAL state this step (done + expired +
+        failed — run loops key on ``req.state``)."""
         finished: list[Request] = []
+        self._sweep_deadlines(finished)
         self._admit(finished)
         if self.n_active == 0:
             return finished
@@ -250,6 +486,7 @@ class Scheduler:
                   if self.slots[s] is not None]
         if not active:  # capacity pressure preempted everyone admitted
             return finished
+        self._fire_faults()
         span = None
         if self.telemetry is not None:
             span = self.telemetry.span(
@@ -264,8 +501,10 @@ class Scheduler:
         finally:
             if span is not None:  # decode() returned host arrays: fenced
                 span.__exit__(None, None, None)
-        step_ms = (time.perf_counter() - t0) * 1e3
+        t1 = time.perf_counter()
+        step_ms = (t1 - t0) * 1e3
         self.n_steps += 1
+        self._rate.append((t1, len(active)))
         for slot in active:
             req = self.slots[slot]
             self._lengths[slot] += 1  # the fed token is now cached
@@ -286,26 +525,99 @@ class Scheduler:
             self.telemetry.flush_metrics(step=self.n_steps)
         return finished
 
+    # -- graceful drain (ISSUE 14) -------------------------------------------
+    def begin_drain(self) -> list[Request]:
+        """Stop admitting: every queued request is shed (typed terminal,
+        reason "draining") and further ``submit`` calls shed on arrival.
+        Active requests keep decoding — the drain loop finishes or
+        expires them.  -> the newly shed requests."""
+        self.draining = True
+        shed: list[Request] = []
+        self._emit(_INST_DRAIN, phase="begin",
+                   in_flight=self.n_active + len(self.queue))
+        while self.queue:
+            req = self.queue.popleft()
+            self.mark_shed(req, "draining")
+            shed.append(req)
+        return shed
+
+    def expire_all_active(self, reason: str) -> list[Request]:
+        """Force every in-flight request terminal (drain deadline): evict
+        and expire with ``reason``.  -> the expired requests."""
+        out: list[Request] = []
+        for slot in range(self.engine.max_batch):
+            if self.slots[slot] is None:
+                continue
+            req = self._evict(slot)
+            self._expire(req, "drain", reason, out)
+        return out
+
+    def end_drain(self) -> None:
+        self._emit(_INST_DRAIN, phase="end", in_flight=self.n_active)
+
 
 def run_open_loop(scheduler: Scheduler, requests: list[Request],
-                  poll_s: float = 0.002) -> tuple[dict[int, Request], float]:
+                  poll_s: float = 0.002, *, drain=None,
+                  drain_s: float = 5.0, on_terminal=None,
+                  between_steps=None) -> tuple[dict[int, Request], float]:
     """Drive synthetic open-loop traffic: each request is submitted when the
     wall clock passes its ``arrival_s`` (arrivals never wait on the server —
     that is what makes the load open-loop), then the scheduler steps until
-    every request finishes.  -> ({rid: finished request}, wall seconds)."""
+    every request reaches a TERMINAL state (done/expired/shed/failed — no
+    request is ever silently lost).  -> ({rid: terminal request}, wall s).
+
+    ``drain``: a zero-arg callable polled every loop pass; once true the
+    loop stops admitting (queued + not-yet-arrived requests shed with
+    reason "draining"), keeps decoding in-flight requests for up to
+    ``drain_s`` seconds, then force-expires the remainder — the SIGTERM
+    half of ``tmserve --drain-s``.  ``on_terminal(req)`` fires once per
+    terminal request (the CLI's REQUESTS.jsonl writer).
+    ``between_steps(scheduler)`` runs every pass — the rollout watcher's
+    between-steps poll point.
+    """
     pending = deque(sorted(requests, key=lambda r: r.arrival_s))
     results: dict[int, Request] = {}
+
+    def _terminal(req: Request) -> None:
+        results[req.rid] = req
+        if on_terminal is not None:
+            on_terminal(req)
+
+    draining = False
+    drain_deadline = 0.0
     t0 = time.perf_counter()
     while len(results) < len(requests):
+        if between_steps is not None:
+            between_steps(scheduler)
+        if drain is not None and not draining and drain():
+            draining = True
+            drain_deadline = time.perf_counter() + drain_s
+            for req in scheduler.begin_drain():
+                _terminal(req)
+            while pending:  # never-submitted arrivals shed too: every id
+                req = pending.popleft()  # must reach a terminal state
+                scheduler.mark_shed(req, "draining")
+                _terminal(req)
         now = time.perf_counter() - t0
-        while pending and pending[0].arrival_s <= now:
-            scheduler.submit(pending.popleft())
+        if not draining:
+            while pending and pending[0].arrival_s <= now:
+                req = pending.popleft()
+                if not scheduler.submit(req):
+                    _terminal(req)
         if scheduler.idle:
+            if draining:
+                break
             if pending:
                 time.sleep(min(poll_s, max(pending[0].arrival_s - now, 0.0)))
             continue
         for req in scheduler.step():
-            results[req.rid] = req
+            _terminal(req)
+        if draining and time.perf_counter() >= drain_deadline:
+            for req in scheduler.expire_all_active("drain deadline"):
+                _terminal(req)
+            break
+    if draining:
+        scheduler.end_drain()
     return results, time.perf_counter() - t0
 
 
@@ -322,6 +634,9 @@ def serve_report(results: dict[int, Request], wall_s: float,
         return {"p50": round(float(np.percentile(arr, 50)), 3),
                 "p99": round(float(np.percentile(arr, 99)), 3)}
 
+    states = {s: 0 for s in TERMINAL_STATES}
+    for r in results.values():
+        states[r.state] = states.get(r.state, 0) + 1
     return {
         "metric": "serve_tokens_per_sec",
         "value": round(n_tokens / wall_s, 2) if wall_s > 0 else 0.0,
@@ -333,6 +648,8 @@ def serve_report(results: dict[int, Request], wall_s: float,
         "token_ms": pct(scheduler.token_ms),
         "preemptions": scheduler.n_preemptions,
         "decode_steps": scheduler.n_steps,
+        "terminal_states": states,
+        "drained": scheduler.draining,
         "quantized_int8": eng.quantized,
         "config": {
             "block_size": eng.block_size,

@@ -26,7 +26,10 @@ the same event stream into *in-flight* typed verdicts:
   ``checkpoint.*`` span has been seen, steps advancing for longer than
   ``checkpoint_deadline_s`` without another is a ``warn``.
 - ``slo`` — serving SLO breach: p99 of the ``serve.ttft_ms`` histogram
-  (carried by ``metrics`` flush events) above ``slo_ttft_p99_ms``.
+  (carried by ``metrics`` flush events) above ``slo_ttft_p99_ms``; at
+  ``slo_critical_factor`` x the SLO the verdict turns *critical*, which
+  the serving rollout watcher's probation window treats as the
+  roll-back-now signal (ISSUE 14).
 
 Verdicts are written atomically to ``HEALTH.json`` in the telemetry
 directory by the owning :class:`~theanompi_tpu.telemetry.core.Telemetry`'s
@@ -92,6 +95,10 @@ class HealthConfig:
     throughput_recent: int = 8
     checkpoint_deadline_s: float = 600.0
     slo_ttft_p99_ms: float | None = None
+    #: ISSUE 14: a p99 at or past ``slo_critical_factor`` x the SLO is a
+    #: CRITICAL verdict (not just a warn) — the serving rollout watcher's
+    #: probation window rolls back on it
+    slo_critical_factor: float = 2.0
 
 
 def _median(xs) -> float:
@@ -193,9 +200,12 @@ class HealthMonitor:
         if p99 is None:
             return
         if p99 > cfg.slo_ttft_p99_ms:
-            self._set("slo", SEV_WARN,
+            critical = p99 >= cfg.slo_ttft_p99_ms * cfg.slo_critical_factor
+            self._set("slo", SEV_CRITICAL if critical else SEV_WARN,
                       f"serve.ttft_ms p99 {p99:.1f}ms breaches SLO "
-                      f"{cfg.slo_ttft_p99_ms:.1f}ms",
+                      f"{cfg.slo_ttft_p99_ms:.1f}ms"
+                      + (f" by >= {cfg.slo_critical_factor:g}x"
+                         if critical else ""),
                       fields={"p99_ms": round(float(p99), 3),
                               "slo_ms": cfg.slo_ttft_p99_ms})
         else:

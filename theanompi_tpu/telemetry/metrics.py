@@ -69,6 +69,35 @@ SERVE_HISTOGRAMS = ("serve.token_ms", "serve.ttft_ms")
 SERVE_GAUGES = ("serve.tokens_per_sec", "serve.active", "serve.free_blocks")
 SERVE_COUNTERS = ("serve.tokens", "serve.preemptions", "serve.requests")
 
+# -- serving request-lifecycle names (ISSUE 14) ------------------------------
+# The hardened request lifecycle emits one instant per NON-done terminal
+# transition (``done`` keeps the original ``serve.finish``):
+# ``serve.expire``: a request blew its ttft/total deadline (tags: request,
+# which — "ttft" | "total", where — "queued" | "active" | "drain");
+# ``serve.shed``: admission-time load shedding or a drain refused the
+# request before any work was done (tags: request, reason, est_wait_ms);
+# ``serve.fail``: the livelock guard refused a request that can never fit
+# the KV pool (tags: request, need_blocks, pool_blocks); ``serve.drain``:
+# the graceful-drain path toggled (tags: phase = "begin" | "end",
+# in_flight).  Matching counters below; emitted through these registered
+# names ONLY (same one-source-of-truth contract as above).
+SERVE_LIFECYCLE_INSTANTS = ("serve.expire", "serve.shed", "serve.fail",
+                            "serve.drain")
+SERVE_LIFECYCLE_COUNTERS = ("serve.expired", "serve.shed_total",
+                            "serve.failed")
+
+# -- live weight-rollout names (ISSUE 14) ------------------------------------
+# ``serve.rollout``: the checkpoint-dir watcher hot-swapped a newly
+# VERIFIED checkpoint between scheduler steps (tags: from_epoch, to_epoch,
+# preempted — active slots recompute under the new weights, no request is
+# dropped); ``serve.rollout_refused``: the newest candidate did not verify
+# — corrupt or half-published — so the old weights keep serving (tags:
+# epoch, reason); ``serve.rollback``: the health monitor's SLO/throughput
+# verdict turned critical inside the probation window, so the previous
+# weights were restored (tags: from_epoch, to_epoch, detector, reason).
+SERVE_ROLLOUT_INSTANTS = ("serve.rollout", "serve.rollout_refused",
+                          "serve.rollback")
+
 # -- elastic-resume instant names (ISSUE 8) ----------------------------------
 # The checkpoint reshard path emits through these registered names ONLY
 # (same one-source-of-truth contract as the serving names above).
