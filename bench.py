@@ -550,7 +550,8 @@ def _acquire_backend(timeout_s: float):
         except Exception as e:  # re-raised on the main thread below
             out["error"] = e
 
-    t = threading.Thread(target=probe, daemon=True)
+    t = threading.Thread(target=probe, name="bench-backend-probe",
+                         daemon=True)
     t.start()
     t.join(timeout_s)
     if t.is_alive():

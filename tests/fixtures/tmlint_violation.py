@@ -5,6 +5,8 @@ tier-1 sweep never sees it.  ``test_tmlint.py`` points the CLI at this
 file and asserts a non-zero exit with one finding per seeded class.
 """
 
+import json
+import threading
 import time
 
 import numpy as np
@@ -37,3 +39,14 @@ def suppression_violation():
     # seeded: rule `suppression` (marker with no justification)
     stamp = time.time()  # lint: wall-ok
     return stamp
+
+
+def atomic_publish_violation(path, obj):
+    with open(path, "w") as f:  # seeded: rule `atomic-publish`
+        json.dump(obj, f)
+
+
+def thread_lifecycle_violation(fn):
+    t = threading.Thread(target=fn, daemon=True)  # seeded: `thread-lifecycle`
+    t.start()
+    return t

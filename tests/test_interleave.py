@@ -1,0 +1,340 @@
+"""ISSUE 15: the deterministic interleaving harness, end to end.
+
+Three tiers:
+
+- **Harness unit tests** — ``Interleaver`` grants sync-points in the
+  exact armed order, unscheduled names pass through, infeasible heads
+  are dropped deterministically (``skipped`` in the trace, never a
+  hang), and both schedule generators (``schedules`` permutations,
+  ``interleavings`` order-preserving merges) are seeded-stable.
+- **Real-seam suites** — every order-preserving interleaving of the
+  three instrumented seams, with state invariants asserted after each:
+  (a) async checkpoint writer vs. the next ``save``/``join_pending``
+  (the PR 5 torn-snapshot seam), (b) fleet scheduler pass vs. episode
+  completion vs. ``adopt()`` (the PR 10/11 registration seam),
+  (c) health ticker tick vs. ``Telemetry.close()``.
+- **Negative proof** — ``race_audit`` detects the seeded lost-update
+  race (and only it), and ``tmlint --race-audit`` exits 1 the moment
+  the harness stops detecting it (the ``hlo_audit`` philosophy: the
+  checker must prove it still has teeth).
+
+Everything here is compile-light: numpy trees, ``python -c`` job specs
+that a stubbed ``run_job`` never actually executes, no XLA compiles.
+"""
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.analysis import cli as lint_cli
+from theanompi_tpu.analysis import interleave
+from theanompi_tpu.analysis.interleave import (
+    RACE_CHAINS,
+    GuardedCounter,
+    Interleaver,
+    RaceAuditError,
+    RacyCounter,
+    interleavings,
+    race_audit,
+    schedules,
+    sp,
+)
+
+CLEAN_SRC = "def f(x):\n    return x + 1\n"
+
+
+# -- harness unit tests ------------------------------------------------------
+
+def test_sp_is_noop_when_disarmed():
+    # must return instantly for any name — the production-path contract
+    sp("never.armed.point")
+    sp("ckpt.write.publish")
+
+
+def test_interleaver_realizes_the_exact_order():
+    # the two RacyCounter outcomes ARE the proof of exact control: the
+    # same code loses the update iff the armed order says so
+    lost = ["a.load", "b.load", "a.store", "b.store"]
+    serial = ["a.load", "a.store", "b.load", "b.store"]
+    assert interleave._run_counter(RacyCounter, list(lost), 2.0) == 1
+    assert interleave._run_counter(RacyCounter, list(serial), 2.0) == 2
+
+
+def test_interleaver_trace_records_grants_in_order():
+    order = ["a.load", "b.load", "a.store", "b.store"]
+    c = RacyCounter()
+    il = Interleaver(list(order))
+    with il:
+        ts = [threading.Thread(target=c.bump, args=(lbl,),
+                               name=f"test-bump-{lbl}") for lbl in ("a", "b")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert il.trace == [(n, "granted") for n in order]
+    assert il.order == []
+
+
+def test_unscheduled_names_pass_through():
+    il = Interleaver(["only.this"], timeout_s=0.2)
+    with il:
+        sp("something.else")  # same thread: would deadlock if it blocked
+        sp("only.this")
+    assert il.trace == [("only.this", "granted")]
+
+
+def test_unreachable_head_is_skipped_not_hung():
+    il = Interleaver(["ghost.point", "real.point"], timeout_s=0.1)
+    with il:
+        sp("real.point")  # blocks behind the ghost until the timeout
+    assert il.trace == [("ghost.point", "skipped"), ("real.point", "granted")]
+
+
+def test_arm_is_exclusive():
+    with Interleaver(["x"]):
+        with pytest.raises(RuntimeError, match="already armed"):
+            interleave.arm(Interleaver(["y"]))
+    interleave.disarm()  # idempotent
+
+
+def test_schedules_full_factorial_and_seeded_sample():
+    full = schedules(["a", "b", "c"])
+    assert len(full) == 6 and len({tuple(s) for s in full}) == 6
+    sample = schedules(list("abcde"), limit=10, seed=3)
+    assert sample == schedules(list("abcde"), limit=10, seed=3)
+    assert len(sample) == 10
+    assert len({tuple(s) for s in sample}) == 10
+    for s in sample:
+        assert sorted(s) == sorted("abcde")
+
+
+def _is_subsequence(chain, merged):
+    it = iter(merged)
+    return all(x in it for x in chain)
+
+
+def test_interleavings_preserve_every_chain_order():
+    chains = [["s1", "s2"], ["w1", "w2", "w3"]]
+    merges = interleavings(chains)
+    assert len(merges) == 10  # C(5,2)
+    assert len({tuple(m) for m in merges}) == 10
+    for m in merges:
+        for c in chains:
+            assert _is_subsequence(c, m)
+    sample = interleavings(chains, limit=4, seed=7)
+    assert sample == interleavings(chains, limit=4, seed=7)
+    assert len(sample) == 4
+    for m in sample:
+        for c in chains:
+            assert _is_subsequence(c, m)
+
+
+# -- the negative proof (race_audit + CLI) -----------------------------------
+
+def test_race_audit_detects_the_seeded_race():
+    report = race_audit()
+    # two 2-chains -> 6 merges; the update is lost exactly when both
+    # loads land before either store (4 of the 6)
+    assert report["orderings"] == 6
+    assert report["racy_lost_updates"] == 4
+    assert report["guarded_lost_updates"] == 0
+    assert report["detected"] is True
+
+
+def test_race_audit_raises_when_defanged(monkeypatch):
+    # swap the racy twin for the guarded one: the audit must notice the
+    # harness no longer detects anything and refuse to pass
+    monkeypatch.setattr(interleave, "RacyCounter", GuardedCounter)
+    with pytest.raises(RaceAuditError, match="lost its teeth") as ei:
+        race_audit()
+    assert ei.value.report["racy_lost_updates"] == 0
+    assert ei.value.report["detected"] is False
+
+
+def test_guarded_counter_clean_under_every_merge():
+    for order in interleavings(RACE_CHAINS):
+        assert interleave._run_counter(GuardedCounter, order, 2.0) == 2
+
+
+def test_cli_race_audit_clean(tmp_path, capsys):
+    p = tmp_path / "clean.py"
+    p.write_text(CLEAN_SRC)
+    rc = lint_cli.main([str(p), "--race-audit"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "race-audit: seeded race detected in 4/6 orderings" in out
+    assert "guarded twin clean" in out
+
+
+def test_cli_race_audit_failure_exits_1(tmp_path, monkeypatch, capsys):
+    monkeypatch.setattr(interleave, "RacyCounter", GuardedCounter)
+    p = tmp_path / "clean.py"
+    p.write_text(CLEAN_SRC)
+    rc = lint_cli.main([str(p), "--race-audit"])
+    cap = capsys.readouterr()
+    assert rc == 1
+    assert "tmlint: error: race-audit" in cap.err
+    assert "lost its teeth" in cap.err
+
+
+def test_cli_race_audit_lands_in_report(tmp_path, capsys):
+    p = tmp_path / "clean.py"
+    p.write_text(CLEAN_SRC)
+    rpath = tmp_path / "report.json"
+    rc = lint_cli.main([str(p), "--race-audit", "--report", str(rpath),
+                        "--quiet"])
+    capsys.readouterr()
+    assert rc == 0
+    report = json.loads(rpath.read_text())
+    assert report["race_audit"]["detected"] is True
+    assert report["race_audit"]["orderings"] == 6
+    assert "race_audit_error" not in report
+
+
+def test_cli_race_audit_failure_report_carries_error(tmp_path, monkeypatch,
+                                                     capsys):
+    monkeypatch.setattr(interleave, "RacyCounter", GuardedCounter)
+    p = tmp_path / "clean.py"
+    p.write_text(CLEAN_SRC)
+    rpath = tmp_path / "report.json"
+    rc = lint_cli.main([str(p), "--race-audit", "--report", str(rpath),
+                        "--quiet"])
+    capsys.readouterr()
+    assert rc == 1
+    report = json.loads(rpath.read_text())
+    assert "lost its teeth" in report["race_audit_error"]
+    assert report["race_audit"]["racy_lost_updates"] == 0
+
+
+# -- seam (a): async checkpoint writer vs. next save/join --------------------
+
+def _ckpt_orders():
+    """save(0) is pinned first (it spawns the writer); then every merge
+    of the writer's chain against the *next* save's trainer chain — the
+    overlap window where the PR 5 torn snapshot lived."""
+    overlap = interleavings([
+        ["ckpt.save", "ckpt.join"],
+        ["ckpt.write.begin", "ckpt.write.publish", "ckpt.write.done"],
+    ])
+    return [["ckpt.save", "ckpt.join"] + m for m in overlap]
+
+
+@pytest.mark.parametrize("order", _ckpt_orders(),
+                         ids=lambda o: "-".join(n.split(".")[-1] for n in o[2:]))
+def test_checkpoint_async_overlap(tmp_path, order):
+    from theanompi_tpu.utils.checkpoint import Checkpointer
+
+    trees = {"params": {"w": np.arange(8, dtype=np.float32),
+                        "b": np.ones((3,), dtype=np.float32)}}
+    d = str(tmp_path / "ckpt")
+    ck = Checkpointer(d, async_save=True)
+    with Interleaver(list(order), timeout_s=2.0):
+        ck.save(0, 10, trees)
+        ck.save(1, 20, trees)   # joins writer-0 per the armed order
+        ck.join_pending()       # writer-1 (its points ran post-order)
+    # invariants under EVERY interleaving: both epochs published whole,
+    # verification passes, latest points at the newest, no tmp debris
+    assert ck.latest_epoch() == 1
+    assert ck.latest_iteration() == 20
+    for epoch, iteration in ((0, 10), (1, 20)):
+        man = ck.verify_epoch(epoch, level="full")
+        assert man["iteration"] == iteration
+    assert not [f for f in os.listdir(d) if ".tmp" in f]
+
+
+# -- seam (b): fleet scheduler pass vs. episode done vs. adopt ---------------
+
+class _StubSupervisor:
+    def terminate(self):
+        pass
+
+
+def _stub_run_job(child_cmd, *, on_supervisor=None, **kw):
+    from theanompi_tpu.resilience import EXIT_CLEAN
+    from theanompi_tpu.resilience.supervisor import JobResult
+
+    if on_supervisor is not None:
+        on_supervisor(_StubSupervisor())
+    return JobResult(exit_code=EXIT_CLEAN, cause="clean", attempts=[],
+                     preempted=False)
+
+
+def _job_spec(jid):
+    from theanompi_tpu.fleet import JobSpec
+
+    return JobSpec(job_id=jid, argv=[sys.executable, "-c", "pass"],
+                   max_restarts=0)
+
+
+FLEET_ORDERS = interleavings([["fleet.pass"], ["fleet.episode.done"],
+                              ["fleet.adopt"]])
+
+
+@pytest.mark.parametrize("order", FLEET_ORDERS,
+                         ids=lambda o: "-".join(n.split(".")[-1] for n in o))
+def test_fleet_pass_vs_episode_vs_adopt(tmp_path, monkeypatch, order):
+    from theanompi_tpu.fleet import JobRecord, read_fleet_events
+    from theanompi_tpu.fleet import scheduler as fleet_scheduler
+    from theanompi_tpu.fleet.jobs import TERMINAL
+    from theanompi_tpu.resilience import EXIT_CLEAN
+
+    monkeypatch.setattr(fleet_scheduler, "run_job", _stub_run_job)
+    d = str(tmp_path / "fleet")
+    sched = fleet_scheduler.FleetScheduler(d, 2, poll_s=0.01, telemetry=False)
+    sched.submit(_job_spec("j1"))
+    rec2 = JobRecord(spec=_job_spec("j2"))  # not persisted: adopt() owns it
+
+    box = {}
+    runner = threading.Thread(target=lambda: box.update(rc=sched.run()),
+                              name="test-fleet-run")
+    with Interleaver(list(order), timeout_s=0.5):
+        runner.start()
+        sched.adopt(rec2)       # main thread races the scheduler loop
+        runner.join(timeout=60)
+    assert not runner.is_alive()
+    assert box["rc"] == EXIT_CLEAN
+    if any(r.status not in TERMINAL for r in sched.records.values()):
+        # the adopt landed after run() drained; one more run picks it up
+        # (the documented dead-scheduler re-own flow), deterministically
+        assert sched.run() == EXIT_CLEAN
+    # invariants under EVERY interleaving: both jobs done exactly once,
+    # all devices back in the pool, the audit log shows both completions
+    assert set(sched.records) == {"j1", "j2"}
+    assert all(r.status == "done" for r in sched.records.values())
+    assert all(r.devices is None for r in sched.records.values())
+    assert sched.ledger.free == 2
+    completes = [e for e in read_fleet_events(d)
+                 if e["event"] == "fleet.complete"]
+    assert sorted(e["job"] for e in completes) == ["j1", "j2"]
+
+
+# -- seam (c): health ticker tick vs. Telemetry.close() ----------------------
+
+HEALTH_ORDERS = interleavings([["health.tick", "health.tick"],
+                               ["health.close"]])
+
+
+@pytest.mark.parametrize("order", HEALTH_ORDERS,
+                         ids=lambda o: "-".join(n.split(".")[-1] for n in o))
+def test_health_tick_vs_close(tmp_path, order):
+    from theanompi_tpu.telemetry import Telemetry
+    from theanompi_tpu.telemetry.sink import read_events
+
+    d = str(tmp_path / "tel")
+    tel = Telemetry(d, rank=0, health={"tick_s": 0.005})
+    with Interleaver(list(order), timeout_s=0.5):
+        tel.close()
+    # invariants under EVERY interleaving: ticker joined, exactly one
+    # session_end, HEALTH.json published whole (atomic replace)
+    assert tel._health_thread is None
+    events = read_events(os.path.join(d, "events-rank00000.jsonl"))
+    ends = [e for e in events
+            if e["kind"] == "meta" and e["name"] == "session_end"]
+    assert len(ends) == 1
+    with open(os.path.join(d, "HEALTH.json")) as f:
+        health = json.load(f)
+    assert health["rank"] == 0

@@ -389,6 +389,29 @@ def test_runbook_tmlint_command(tmp_path, capsys):
     assert rep["summary"]["suppressed"] > 0  # markers stay visible
 
 
+def test_runbook_tmlint_concurrency_tier(capsys):
+    """BASELINE step 7's concurrency dry-run (ISSUE 15): the exact
+    `tmlint --rules atomic-publish,guarded-state,thread-lifecycle,lock-order`
+    subset must sweep the package clean — every durable writer publishes
+    via os.replace (or carries a justified suppression), mixed-guard
+    state and unnamed/unjoined threads stay out, and every nested lock
+    acquisition matches the declared LOCK_ORDER_DAG."""
+    from theanompi_tpu.analysis import cli as lint_cli
+
+    rc = lint_cli.main(["--rules",
+                        "atomic-publish,guarded-state,thread-lifecycle,"
+                        "lock-order", "--quiet"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 finding(s)" in out
+    # the append-mode audit logs ride on justified suppressions — they
+    # must stay visible in the summary, not vanish
+    import re
+
+    m = re.search(r"(\d+) suppressed", out)
+    assert m and int(m.group(1)) > 0
+
+
 def test_runbook_fleet_command(tmp_path, monkeypatch, subproc_compile_cache):
     """RUNBOOK step 8's fleet rehearsal (ISSUE 11) at toy scale: the exact
     `tmfleet submit` / `run` / `status` flags BASELINE.md documents must

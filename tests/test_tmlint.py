@@ -52,7 +52,8 @@ def test_registry_has_the_advertised_rules():
     names = set(core.all_rules())
     assert {"wall", "swallow", "np-load", "donated-escape", "host-sync",
             "jit-nondet", "exit-code", "import-dag",
-            "data-determinism"} <= names
+            "data-determinism", "atomic-publish", "guarded-state",
+            "thread-lifecycle", "lock-order"} <= names
 
 
 # ---------------------------------------------------------------------------
@@ -466,7 +467,8 @@ def test_cli_exits_nonzero_on_the_seeded_violation_file(capsys):
     out = capsys.readouterr().out
     assert rc == 1
     for rule in ("wall", "swallow", "np-load", "donated-escape",
-                 "exit-code", "suppression"):
+                 "exit-code", "suppression", "atomic-publish",
+                 "thread-lifecycle"):
         assert f"[{rule}]" in out, f"seeded {rule} violation not caught"
 
 
@@ -522,3 +524,297 @@ def test_cli_clean_package_report(tmp_path):
     assert rep["findings"] == []
     assert rep["summary"]["errors"] == 0
     assert rep["summary"]["suppressed"] > 0  # justified markers, visible
+
+
+# ---------------------------------------------------------------------------
+# the concurrency tier (ISSUE 15): atomic-publish / guarded-state /
+# thread-lifecycle / lock-order
+# ---------------------------------------------------------------------------
+
+from theanompi_tpu.analysis import rules as R
+
+
+def test_atomic_publish_flags_direct_write(tmp_path):
+    active, _ = run_src(tmp_path, (
+        "import json\n"
+        "def publish(path, obj):\n"
+        "    with open(path, 'w') as f:\n"
+        "        json.dump(obj, f)\n"), rules=["atomic-publish"])
+    assert [f.rule for f in active] == ["atomic-publish"]
+    assert "os.replace" in active[0].message
+
+
+def test_atomic_publish_flags_append_mode(tmp_path):
+    active, _ = run_src(tmp_path, (
+        "def log(path, line):\n"
+        "    with open(path, 'a') as f:\n"
+        "        f.write(line)\n"), rules=["atomic-publish"])
+    assert [f.rule for f in active] == ["atomic-publish"]
+    assert "torn tail" in active[0].message
+
+
+def test_atomic_publish_append_suppressible_with_justification(tmp_path):
+    active, sup = run_src(tmp_path, (
+        "def log(path, line):\n"
+        "    # lint: atomic-publish-ok — JSONL, readers skip torn tails\n"
+        "    with open(path, 'a') as f:\n"
+        "        f.write(line)\n"), rules=["atomic-publish"])
+    assert not active
+    assert [f.rule for f in sup] == ["atomic-publish"]
+
+
+def test_atomic_publish_flags_unpublished_tmp(tmp_path):
+    active, _ = run_src(tmp_path, (
+        "def publish(path, data):\n"
+        "    with open(path + '.tmp', 'w') as f:\n"
+        "        f.write(data)\n"), rules=["atomic-publish"])
+    assert [f.rule for f in active] == ["atomic-publish"]
+    assert "never published" in active[0].message
+
+
+def test_atomic_publish_accepts_the_idiom(tmp_path):
+    # direct-constant tmp suffix, name bound to a tmp expr, and f-string
+    # tmp — the three spellings the package actually uses
+    active, _ = run_src(tmp_path, (
+        "import json, os\n"
+        "def publish(path, obj):\n"
+        "    with open(path + '.tmp', 'w') as f:\n"
+        "        json.dump(obj, f)\n"
+        "    os.replace(path + '.tmp', path)\n"
+        "def publish2(path, obj):\n"
+        "    tmp = path + '.tmp'\n"
+        "    with open(tmp, 'w') as f:\n"
+        "        json.dump(obj, f)\n"
+        "    os.replace(tmp, path)\n"
+        "def publish3(path, obj):\n"
+        "    tmp = f'{path}.tmp.{os.getpid()}'\n"
+        "    with open(tmp, 'w') as f:\n"
+        "        json.dump(obj, f)\n"
+        "    os.replace(tmp, path)\n"), rules=["atomic-publish"])
+    assert not active
+
+
+def test_atomic_publish_ignores_reads_and_dynamic_modes(tmp_path):
+    active, _ = run_src(tmp_path, (
+        "def load(path, mode):\n"
+        "    with open(path) as f:\n"
+        "        a = f.read()\n"
+        "    with open(path, 'r+b') as f:\n"
+        "        b = f.read()\n"
+        "    with open(path, mode) as f:\n"  # statically unknown: skip
+        "        c = f.read()\n"
+        "    return a, b, c\n"), rules=["atomic-publish"])
+    assert not active
+
+
+GUARDED_MIXED = """
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.entries = {}
+
+    def register(self, e):
+        with self._lock:
+            self.entries = e
+
+    def reset(self):
+        self.entries = None
+"""
+
+
+def test_guarded_state_flags_mixed_assignment(tmp_path):
+    active, _ = run_src(tmp_path, GUARDED_MIXED, rules=["guarded-state"])
+    assert [f.rule for f in active] == ["guarded-state"]
+    assert "entries" in active[0].message
+    # the flagged site is the UNGUARDED one (reset), not register
+    assert active[0].line == GUARDED_MIXED.splitlines().index(
+        "        self.entries = None") + 1
+
+
+def test_guarded_state_init_is_exempt(tmp_path):
+    active, _ = run_src(tmp_path, (
+        "import threading\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.v = 0\n"
+        "    def set(self, v):\n"
+        "        with self._lock:\n"
+        "            self.v = v\n"), rules=["guarded-state"])
+    assert not active
+
+
+def test_guarded_state_ignores_lockless_classes(tmp_path):
+    active, _ = run_src(tmp_path, (
+        "class Plain:\n"
+        "    def a(self):\n"
+        "        self.v = 1\n"
+        "    def b(self):\n"
+        "        self.v = 2\n"), rules=["guarded-state"])
+    assert not active
+
+
+def test_guarded_state_helper_called_under_lock_counts_guarded(tmp_path):
+    # the EventSink._rotate idiom: a helper whose every call site holds
+    # the lock assigns state without a lexical with — not a finding
+    active, _ = run_src(tmp_path, (
+        "import threading\n"
+        "class Sink:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.size = 0\n"
+        "    def emit(self, n):\n"
+        "        with self._lock:\n"
+        "            self.size += n\n"
+        "            if self.size > 10:\n"
+        "                self._rotate()\n"
+        "    def _rotate(self):\n"
+        "        self.size = 0\n"), rules=["guarded-state"])
+    assert not active
+
+
+def test_guarded_state_helper_with_unlocked_call_site_fires(tmp_path):
+    # one call site outside the lock disqualifies the helper — ambiguity
+    # is the bug
+    active, _ = run_src(tmp_path, (
+        "import threading\n"
+        "class Sink:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.size = 0\n"
+        "    def emit(self, n):\n"
+        "        with self._lock:\n"
+        "            self.size += n\n"
+        "            self._rotate()\n"
+        "    def close(self):\n"
+        "        self._rotate()\n"
+        "    def _rotate(self):\n"
+        "        self.size = 0\n"), rules=["guarded-state"])
+    assert [f.rule for f in active] == ["guarded-state"]
+
+
+def test_thread_lifecycle_flags_unnamed_thread(tmp_path):
+    active, _ = run_src(tmp_path, (
+        "import threading\n"
+        "def go(fn):\n"
+        "    t = threading.Thread(target=fn, daemon=True)\n"
+        "    t.start()\n"
+        "    return t\n"), rules=["thread-lifecycle"])
+    assert [f.rule for f in active] == ["thread-lifecycle"]
+    assert "unnamed" in active[0].message
+
+
+def test_thread_lifecycle_accepts_named_daemon(tmp_path):
+    active, _ = run_src(tmp_path, (
+        "import threading\n"
+        "def go(fn):\n"
+        "    t = threading.Thread(target=fn, name='seam', daemon=True)\n"
+        "    t.start()\n"
+        "    return t\n"), rules=["thread-lifecycle"])
+    assert not active
+
+
+def test_thread_lifecycle_nondaemon_needs_a_join(tmp_path):
+    src = (
+        "import threading\n"
+        "import os\n"
+        "def go(fn, d):\n"
+        "    p = os.path.join(d, 'x')\n"  # not a thread join
+        "    t = threading.Thread(target=fn, name='seam')\n"
+        "    t.start()\n"
+        "    return t, p\n")
+    active, _ = run_src(tmp_path, src, rules=["thread-lifecycle"])
+    assert [f.rule for f in active] == ["thread-lifecycle"]
+    assert "non-daemon" in active[0].message
+    active, _ = run_src(tmp_path, src + (
+        "def wait(t):\n"
+        "    t.join()\n"), rules=["thread-lifecycle"])
+    assert not active
+
+
+def test_lock_order_dag_declaration_is_valid():
+    R.validate_lock_order()  # the shipped declaration must parse
+
+
+def test_lock_order_rejects_forward_references():
+    with pytest.raises(ValueError):
+        R.validate_lock_order((
+            ("outer", ("pkg/a.py", "_lock"), ("inner",), False),
+            ("inner", ("pkg/b.py", "_lock"), (), False),
+        ))
+
+
+_TEST_LOCK_DAG = (
+    # prefix matches run_src's synthetic fixture path
+    ("inner", ("fx.py", "_inner"), (), False),
+    ("outer", ("fx.py", "_outer"), ("inner",), True),
+)
+
+
+def test_lock_order_flags_undeclared_nesting(tmp_path, monkeypatch):
+    monkeypatch.setattr(R, "LOCK_ORDER_DAG", _TEST_LOCK_DAG)
+    active, _ = run_src(tmp_path, (
+        "class C:\n"
+        "    def bad(self):\n"
+        "        with self._inner:\n"
+        "            with self._outer:\n"  # inner->outer: not declared
+        "                pass\n"), rules=["lock-order"])
+    assert [f.rule for f in active] == ["lock-order"]
+    assert "LOCK_ORDER_DAG" in active[0].message
+
+
+def test_lock_order_accepts_declared_nesting(tmp_path, monkeypatch):
+    monkeypatch.setattr(R, "LOCK_ORDER_DAG", _TEST_LOCK_DAG)
+    active, _ = run_src(tmp_path, (
+        "class C:\n"
+        "    def ok(self):\n"
+        "        with self._outer:\n"
+        "            with self._inner:\n"
+        "                pass\n"
+        "    def multi(self):\n"
+        "        with self._outer, self._inner:\n"  # left-to-right
+        "            pass\n"), rules=["lock-order"])
+    assert not active
+
+
+def test_lock_order_multi_item_with_is_ordered(tmp_path, monkeypatch):
+    monkeypatch.setattr(R, "LOCK_ORDER_DAG", _TEST_LOCK_DAG)
+    active, _ = run_src(tmp_path, (
+        "class C:\n"
+        "    def bad(self):\n"
+        "        with self._inner, self._outer:\n"
+        "            pass\n"), rules=["lock-order"])
+    assert [f.rule for f in active] == ["lock-order"]
+
+
+def test_lock_order_self_deadlock_vs_reentrant(tmp_path, monkeypatch):
+    monkeypatch.setattr(R, "LOCK_ORDER_DAG", _TEST_LOCK_DAG)
+    active, _ = run_src(tmp_path, (
+        "class C:\n"
+        "    def bad(self):\n"
+        "        with self._inner:\n"
+        "            with self._inner:\n"  # non-reentrant: deadlock
+        "                pass\n"
+        "    def ok(self):\n"
+        "        with self._outer:\n"
+        "            with self._outer:\n"  # declared reentrant (RLock)
+        "                pass\n"), rules=["lock-order"])
+    assert len(active) == 1
+    assert "self-deadlock" in active[0].message
+
+
+def test_lock_order_nested_def_resets_lexical_scope(tmp_path, monkeypatch):
+    # a closure defined inside a with-block runs on its caller's
+    # schedule, not under the enclosing lock — no finding
+    monkeypatch.setattr(R, "LOCK_ORDER_DAG", _TEST_LOCK_DAG)
+    active, _ = run_src(tmp_path, (
+        "class C:\n"
+        "    def ok(self):\n"
+        "        with self._inner:\n"
+        "            def cb():\n"
+        "                with self._outer:\n"
+        "                    pass\n"
+        "            return cb\n"), rules=["lock-order"])
+    assert not active

@@ -1,19 +1,28 @@
 """theanompi_tpu.analysis — the ``tmlint`` static-analysis subsystem.
 
-Two halves:
+Three halves:
 
 - **AST rules** (:mod:`.core`, :mod:`.rules`, :mod:`.layers`): a rule
   registry run over one shared parse per file — wall-clock discipline,
   exception swallowing, np.load confinement, donated-buffer escapes,
-  host syncs in spans, jit nondeterminism, exit-code literals, and the
-  declared package-layer DAG.  Console script: ``tmlint``.
+  host syncs in spans, jit nondeterminism, exit-code literals, the
+  declared package-layer DAG, and the ISSUE 15 concurrency tier
+  (atomic-publish, guarded-state, thread-lifecycle, lock-order).
+  Console script: ``tmlint``.
 - **Compiled-artifact audit** (:mod:`.hlo_audit`): jit representative
   train/serve steps and assert what the AST cannot see — donation
   actually applied, the PR 2 collective-count lock, no host callbacks
   in the HLO.
+- **Interleaving harness** (:mod:`.interleave`): ``sp(name)``
+  sync-points threaded through the thread seams (checkpoint writer,
+  fleet passes, health ticker), a deterministic scheduler that replays
+  exact interleavings, and the ``tmlint --race-audit`` negative proof
+  that the harness still detects the seeded lost-update race.
 
 Import surface is deliberately lazy: ``from theanompi_tpu.analysis import
-core`` pulls no jax; only ``hlo_audit`` needs a backend.
+core`` pulls no jax; only ``hlo_audit`` needs a backend (``interleave``
+is stdlib-only — it sits at the bottom of the layer DAG so the
+instrumented seams can import it).
 """
 
 from theanompi_tpu.analysis.core import (  # noqa: F401

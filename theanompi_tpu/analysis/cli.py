@@ -10,7 +10,10 @@ Shares the repo's one-line-error exit contract (tmlauncher/tmserve):
 ``--report FILE`` writes the JSON artifact (schema locked by test);
 ``--hlo-audit`` additionally runs the compiled-artifact auditor, which
 needs jax and a few seconds of XLA compile — the plain AST run stays
-dependency-light and fast for pre-commit use.
+dependency-light and fast for pre-commit use.  ``--race-audit`` runs
+the interleaving harness's negative proof (pure Python, no jax): the
+seeded lost-update race must be detected and its lock-guarded twin
+must stay clean, or the run exits 1.
 """
 
 from __future__ import annotations
@@ -39,6 +42,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--hlo-audit", action="store_true",
                    help="also audit compiled train/serve steps (donation, "
                         "collective counts, host callbacks; needs jax)")
+    p.add_argument("--race-audit", action="store_true",
+                   help="run the interleaving harness self-check: the "
+                        "seeded synthetic race must be detected, the "
+                        "guarded twin must stay clean (pure Python)")
     p.add_argument("--show-suppressed", action="store_true",
                    help="print suppressed findings too (always in --report)")
     p.add_argument("--quiet", action="store_true",
@@ -95,6 +102,22 @@ def main(argv: list[str] | None = None) -> int:
             _error_line("hlo-audit", e)
             return 2
 
+    race_report = None
+    race_failure = None
+    if args.race_audit:
+        from theanompi_tpu.analysis import interleave
+
+        try:
+            race_report = interleave.race_audit()
+        except interleave.RaceAuditError as e:
+            # same contract as --hlo-audit: a failed negative proof is a
+            # FINDING (the harness lost its teeth), not a usage error
+            race_failure = str(e)
+            race_report = getattr(e, "report", None)
+        except Exception as e:
+            _error_line("race-audit", e)
+            return 2
+
     active = [f for f in findings if not f.suppressed]
     if not args.quiet:
         for f in findings:
@@ -108,6 +131,12 @@ def main(argv: list[str] | None = None) -> int:
              if audit_reports is not None else ""))
     if audit_failure is not None:
         _error_line("hlo-audit", audit_failure)
+    if race_report is not None and race_failure is None:
+        print(f"tmlint: race-audit: seeded race detected in "
+              f"{race_report['racy_lost_updates']}/"
+              f"{race_report['orderings']} orderings; guarded twin clean")
+    if race_failure is not None:
+        _error_line("race-audit", race_failure)
 
     if args.report:
         report = core.build_report(
@@ -117,6 +146,10 @@ def main(argv: list[str] | None = None) -> int:
             report["hlo_audit"] = audit_reports
         if audit_failure is not None:
             report["hlo_audit_error"] = audit_failure
+        if race_report is not None:
+            report["race_audit"] = race_report
+        if race_failure is not None:
+            report["race_audit_error"] = race_failure
         try:
             core.write_report(report, args.report)
         except OSError as e:
@@ -125,7 +158,7 @@ def main(argv: list[str] | None = None) -> int:
         if not args.quiet:
             print(f"tmlint: report written to {args.report}")
 
-    return 1 if active or audit_failure else 0
+    return 1 if active or audit_failure or race_failure else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,314 @@
+"""Deterministic interleaving harness: sync-points + schedule replay.
+
+Every concurrency bug this repo has shipped (the PR 5 torn async
+snapshot, the PR 10 ``on_supervisor`` registration race) was found by
+accident — a lucky CI timing, a user report — because thread
+interleavings are the one input the test suite never controlled.  This
+module makes them an input.
+
+The contract mirrors ``resilience/faults.py``: production code is
+instrumented with named **sync-points**, ``sp("ckpt.write.publish")``,
+which cost a single ``is None`` check when no schedule is armed — the
+instrumented seams (checkpoint writer, fleet scheduler passes, health
+ticker) pay nothing in real runs.  A test arms an :class:`Interleaver`
+with an explicit ordering of sync-point names; each thread reaching a
+scheduled point blocks until its name is at the head of the order, so
+one schedule == one exact interleaving, replayable bit-stably.
+
+Two schedule generators:
+
+- :func:`schedules` — raw permutations of a name list (seeded sample
+  when the full factorial exceeds ``limit``).
+- :func:`interleavings` — order-preserving merges of per-thread chains;
+  every generated schedule respects each thread's program order, so
+  none of them can deadlock the harness.  This is the right generator
+  for real seams, where each thread's points are sequenced.
+
+Infeasible orderings (a head no thread can reach, e.g. a raw
+permutation that puts a thread's second point before its first) do not
+hang: a blocked waiter times out and the stuck head is dropped as
+``skipped``, deterministically, so every schedule terminates with a
+recorded trace.
+
+Negative proof (the ``hlo_audit`` philosophy): :func:`race_audit` runs
+a seeded lost-update race (:class:`RacyCounter`) and its lock-guarded
+twin (:class:`GuardedCounter`) under every 2-thread interleaving and
+raises :class:`RaceAuditError` unless the race is detected AND the
+guarded twin stays clean — if the harness ever stops catching the bug
+it was built for, ``tmlint --race-audit`` exits 1.
+
+This module is deliberately stdlib-only (``threading`` + ``math`` +
+``random``): it sits at the *bottom* of the import DAG (see
+``layers.LAYER_DAG``) so leaf subpackages like telemetry may import it.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+import threading
+import time
+
+__all__ = [
+    "sp", "arm", "disarm", "Interleaver", "schedules", "interleavings",
+    "RacyCounter", "GuardedCounter", "race_audit", "RaceAuditError",
+    "RACE_CHAINS",
+]
+
+#: the armed schedule, or None.  Read without a lock: ``sp`` must cost a
+#: single attribute load + is-None test in production (the faults.py
+#: zero-cost contract); arming happens only in tests, via ``with``.
+_ARMED: "Interleaver | None" = None
+
+
+def sp(name: str) -> None:
+    """Sync-point: no-op unless a schedule is armed (zero cost: one
+    ``is None`` check), else block until ``name`` reaches the head of
+    the armed order."""
+    s = _ARMED
+    if s is not None:
+        s.reach(name)
+
+
+def arm(interleaver: "Interleaver") -> None:
+    global _ARMED
+    if _ARMED is not None:
+        raise RuntimeError("an Interleaver is already armed")
+    _ARMED = interleaver
+
+
+def disarm() -> None:
+    global _ARMED
+    _ARMED = None
+
+
+class Interleaver:
+    """One explicit interleaving: a list of sync-point names, granted
+    strictly in order.
+
+    Threads reaching a name not in the (remaining) order pass through
+    untouched — instrumented code outside the scheduled window never
+    blocks.  A thread reaching a scheduled name waits until that name
+    is the head; if the head goes unclaimed for ``timeout_s`` (nobody
+    can reach it — an infeasible ordering, or the seam simply never
+    fires it) the head is dropped as ``skipped`` and the schedule moves
+    on, so every schedule terminates.  ``trace`` records the realized
+    history as ``(name, "granted" | "skipped")`` pairs.
+
+    Use as a context manager to arm/disarm around the scheduled window::
+
+        with Interleaver(["a.load", "b.load", "a.store", "b.store"]):
+            ... start threads, join them ...
+    """
+
+    def __init__(self, order, timeout_s: float = 2.0):
+        self.order: list[str] = [str(n) for n in order]
+        self.timeout_s = float(timeout_s)
+        self.trace: list[tuple[str, str]] = []
+        self._cond = threading.Condition()
+
+    def reach(self, name: str) -> None:
+        with self._cond:
+            if name not in self.order:
+                return
+            head = self.order[0]
+            deadline = time.monotonic() + self.timeout_s
+            while self.order and self.order[0] != name:
+                if self.order[0] != head:
+                    # the head changed — progress happened; reset the
+                    # clock so only a genuinely stuck head gets dropped
+                    head = self.order[0]
+                    deadline = time.monotonic() + self.timeout_s
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    dropped = self.order.pop(0)
+                    self.trace.append((dropped, "skipped"))
+                    self._cond.notify_all()
+                    if name not in self.order:
+                        return
+                    head = self.order[0] if self.order else None
+                    deadline = time.monotonic() + self.timeout_s
+                    continue
+                self._cond.wait(min(remaining, 0.05))
+                if name not in self.order:
+                    return  # our entry was skipped by another waiter
+            if self.order and self.order[0] == name:
+                self.order.pop(0)
+                self.trace.append((name, "granted"))
+                self._cond.notify_all()
+
+    def __enter__(self) -> "Interleaver":
+        arm(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        disarm()
+
+
+def schedules(points, limit: int | None = 24, seed: int = 0):
+    """Deterministic orderings of ``points``: all permutations when the
+    factorial fits ``limit``, else a seeded unranked sample of exactly
+    ``limit`` distinct permutations.  Same (points, limit, seed) ->
+    same list, always — schedules are test inputs and must be stable."""
+    pts = list(points)
+    total = math.factorial(len(pts))
+    if limit is None or total <= limit:
+        return [list(p) for p in itertools.permutations(pts)]
+    rng = random.Random(seed)
+    return [_perm_at(pts, k) for k in sorted(rng.sample(range(total), limit))]
+
+
+def _perm_at(items, k: int) -> list:
+    """The k-th permutation of ``items`` in lexicographic index order."""
+    pool = list(items)
+    out = []
+    for i in range(len(pool), 0, -1):
+        f = math.factorial(i - 1)
+        j, k = divmod(k, f)
+        out.append(pool.pop(j))
+    return out
+
+
+def interleavings(chains, limit: int | None = None, seed: int = 0):
+    """Order-preserving merges of per-thread sync-point chains.
+
+    ``chains`` is a sequence of name lists, one per thread, each in that
+    thread's program order.  Every returned schedule keeps each chain's
+    internal order, so a feasible execution exists for all of them — no
+    skipped heads, no timeout waits.  All merges when the multinomial
+    count fits ``limit``, else a seeded unranked sample."""
+    chains = [list(c) for c in chains if c]
+    total = _merge_count([len(c) for c in chains])
+    if limit is None or total <= limit:
+        return [_merge_at(chains, k) for k in range(total)]
+    rng = random.Random(seed)
+    return [_merge_at(chains, k)
+            for k in sorted(rng.sample(range(total), limit))]
+
+
+def _merge_count(lens) -> int:
+    n = sum(lens)
+    out = math.factorial(n)
+    for ln in lens:
+        out //= math.factorial(ln)
+    return out
+
+
+def _merge_at(chains, k: int) -> list[str]:
+    """The k-th merge in the order induced by always counting chain-0
+    continuations first (a mixed-radix unranking; bijective, so sampled
+    indices give distinct schedules)."""
+    pos = [0] * len(chains)
+    out = []
+    remaining = [len(c) for c in chains]
+    while any(r for r in remaining):
+        for i, c in enumerate(chains):
+            if not remaining[i]:
+                continue
+            remaining[i] -= 1
+            below = _merge_count(remaining)
+            remaining[i] += 1
+            if k < below:
+                out.append(c[pos[i]])
+                pos[i] += 1
+                remaining[i] -= 1
+                break
+            k -= below
+    return out
+
+
+# -- seeded synthetic race (the negative proof) ------------------------------
+
+class RacyCounter:
+    """Deliberately unguarded read-modify-write — the exact lost-update
+    shape of the PR 10 registration race.  Exists so :func:`race_audit`
+    can prove the harness still *detects* races; never use in product
+    code."""
+
+    def __init__(self):
+        self.value = 0
+
+    def bump(self, label: str) -> None:
+        sp(f"{label}.load")
+        v = self.value
+        sp(f"{label}.store")
+        self.value = v + 1
+
+
+class GuardedCounter:
+    """The fixed twin: same sync-point alphabet, RMW under a lock.  Its
+    job in :func:`race_audit` is the false-positive check — a harness
+    that 'detects' a race here is broken."""
+
+    def __init__(self):
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def bump(self, label: str) -> None:
+        # points stay OUTSIDE the lock: a scheduled wait while holding
+        # the lock would stall the peer thread into timeout skips
+        sp(f"{label}.load")
+        sp(f"{label}.store")
+        with self._lock:
+            self.value += 1
+
+
+#: per-thread sync-point chains of the two-bumper race scenario
+RACE_CHAINS = (("a.load", "a.store"), ("b.load", "b.store"))
+
+
+class RaceAuditError(AssertionError):
+    """The interleaving harness lost its teeth (seeded race undetected)
+    or grew false ones (guarded twin 'races').  Carries the audit
+    counters as ``.report``."""
+
+    def __init__(self, msg: str, report: dict | None = None):
+        super().__init__(msg)
+        self.report = report
+
+
+def _run_counter(cls, order, timeout_s: float) -> int:
+    c = cls()
+    threads = [threading.Thread(target=c.bump, args=(lbl,),
+                                name=f"interleave-{lbl}")
+               for lbl, _ in (("a", 0), ("b", 0))]
+    with Interleaver(order, timeout_s=timeout_s):
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    return c.value
+
+
+def race_audit(limit: int | None = None, timeout_s: float = 2.0,
+               seed: int = 0) -> dict:
+    """Self-check the harness against the seeded race; -> audit report.
+
+    Runs every order-preserving interleaving of :data:`RACE_CHAINS`
+    over both counters.  Healthy means the racy twin loses at least one
+    update (detection works) and the guarded twin never does (no false
+    positives); anything else raises :class:`RaceAuditError`.
+    """
+    orders = interleavings(RACE_CHAINS, limit=limit, seed=seed)
+    racy_lost = sum(1 for o in orders
+                    if _run_counter(RacyCounter, o, timeout_s) != 2)
+    guarded_lost = sum(1 for o in orders
+                       if _run_counter(GuardedCounter, o, timeout_s) != 2)
+    report = {
+        "orderings": len(orders),
+        "racy_lost_updates": racy_lost,
+        "guarded_lost_updates": guarded_lost,
+        "detected": racy_lost > 0,
+    }
+    if racy_lost == 0:
+        raise RaceAuditError(
+            "interleave audit: seeded lost-update race was NOT detected in "
+            f"any of {len(orders)} orderings — the harness lost its teeth",
+            report)
+    if guarded_lost:
+        raise RaceAuditError(
+            f"interleave audit: lock-guarded twin lost updates in "
+            f"{guarded_lost}/{len(orders)} orderings — false positive",
+            report)
+    return report

@@ -50,9 +50,15 @@ PKG = "theanompi_tpu"
 #: default for new top-level modules.  In-layer imports are always
 #: allowed.
 LAYER_DAG: tuple[tuple[str, tuple[str, ...], tuple[str, ...]], ...] = (
+    # the interleave harness is stdlib-only sync-points (ISSUE 15) —
+    # a bottom layer like codes, so the instrumented seams (telemetry
+    # ticker, checkpoint writer, fleet passes) may import sp() without
+    # puncturing their walls; longest-prefix assignment peels it off
+    # the analysis layer above
+    ("syncpoint",  (f"{PKG}.analysis.interleave",), ()),
     ("codes",      (f"{PKG}.resilience.codes",), ()),
     ("native",     (f"{PKG}.native",), ()),
-    ("telemetry",  (f"{PKG}.telemetry",), ()),
+    ("telemetry",  (f"{PKG}.telemetry",), ("syncpoint",)),
     ("resilience", (f"{PKG}.resilience",), ("codes", "telemetry")),
     ("mesh",       (f"{PKG}.parallel.mesh",), ()),
     ("kernels",    (f"{PKG}.ops.initializers", f"{PKG}.ops.layers",
@@ -74,7 +80,8 @@ LAYER_DAG: tuple[tuple[str, tuple[str, ...], tuple[str, ...]], ...] = (
                    ("mesh", "kernels", "sharding", "ops", "utils_base",
                     "exchange", "data")),
     ("ckpt",       (f"{PKG}.utils.checkpoint",),
-                   ("codes", "telemetry", "resilience", "utils_base")),
+                   ("syncpoint", "codes", "telemetry", "resilience",
+                    "utils_base")),
     ("training",   (f"{PKG}.parallel",),
                    ("codes", "telemetry", "resilience", "mesh", "kernels",
                     "sharding", "ops", "utils_base", "exchange", "data",
@@ -87,7 +94,8 @@ LAYER_DAG: tuple[tuple[str, tuple[str, ...], tuple[str, ...]], ...] = (
     # never import the training (or serving) machinery it supervises;
     # its world is exit codes, the run_job seam, fault plans, telemetry
     ("fleet",      (f"{PKG}.fleet",),
-                   ("codes", "telemetry", "resilience", "utils_base")),
+                   ("syncpoint", "codes", "telemetry", "resilience",
+                    "utils_base")),
     # serving is a read-only consumer: kernels (shared int8 wire format),
     # verified checkpoint loads, telemetry, the launcher's config surface
     # — NEVER exchange/training (see the any-depth wall below).
@@ -98,7 +106,8 @@ LAYER_DAG: tuple[tuple[str, tuple[str, ...], tuple[str, ...]], ...] = (
                    ("codes", "telemetry", "kernels", "utils_base", "ckpt",
                     "tooling", "resilience")),
     ("analysis",   (f"{PKG}.analysis",),
-                   ("codes", "native", "telemetry", "resilience", "mesh",
+                   ("syncpoint", "codes", "native", "telemetry",
+                    "resilience", "mesh",
                     "kernels", "sharding", "ops", "utils_base", "exchange",
                     "data", "models", "ckpt", "training", "tooling",
                     "fleet", "serving")),
@@ -147,7 +156,10 @@ FLEET_FORBIDDEN_IMPORTS = (
 #: above depends on them, so even a lazy upward import risks a cycle
 #: (and telemetry in particular must stay importable before jax init)
 LEAF_SUBPACKAGES = {
-    f"{PKG}.telemetry": (f"{PKG}.telemetry",),
+    # telemetry may additionally reach the stdlib-only sync-point module
+    # (ISSUE 15: the health ticker is an instrumented seam) — interleave
+    # imports nothing in-package, so the leaf stays cycle-free
+    f"{PKG}.telemetry": (f"{PKG}.telemetry", f"{PKG}.analysis.interleave"),
     # resilience may reach telemetry (ISSUE 13: registered event names +
     # the watchdog's flight-recorder dump) — still downward-only, so the
     # no-cycles property holds: telemetry itself stays a strict leaf

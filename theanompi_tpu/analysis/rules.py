@@ -27,6 +27,27 @@ incident history:
   emission site in ``serving/``/``resilience/`` is a typo'd or drifted
   name the registry in ``telemetry/metrics.py`` cannot catch.
 
+The concurrency tier (ISSUE 15) — every threading bug shipped so far
+(PR 5's torn async snapshot, PR 10's ``on_supervisor`` registration
+race) was found by accident; these make thread discipline a checked
+invariant:
+
+- ``atomic-publish`` — durable artifacts (JSON reports, manifests,
+  health files) must publish via tmp→``os.replace``; a direct or
+  append-mode write is a torn read waiting for a crash, unless the
+  format provably tolerates torn tails (JSONL sinks — suppress with
+  that justification).
+- ``guarded-state`` — in a class that owns a ``Lock``/``RLock``, an
+  attribute assigned both under ``with self._lock:`` and outside it is
+  the PR 10 registration-race shape: half the writers think the lock
+  protects it.
+- ``thread-lifecycle`` — every ``threading.Thread`` carries a ``name``
+  (tmhealth/blackbox dumps and py-spy output must identify the seam);
+  non-daemon threads need a reachable ``join`` or they outlive the run.
+- ``lock-order`` — nested ``with``-acquisitions are checked against the
+  declared :data:`LOCK_ORDER_DAG` (``layers.LAYER_DAG`` style); an
+  undeclared nesting is a deadlock candidate.
+
 Every rule is heuristic where it must be (static analysis cannot prove a
 buffer is donated); the escape hatch is the suppression grammar in
 :mod:`theanompi_tpu.analysis.core` — inline, justified, reported.
@@ -774,3 +795,445 @@ class TelemetryRegisteredNamesRule(Rule):
                 f".{node.func.attr}() — bind the registered name from "
                 f"theanompi_tpu.telemetry.metrics so detectors and "
                 f"aggregators see the same spelling")
+
+
+# ---------------------------------------------------------------------------
+# the concurrency tier (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def _nearest_function(src: SourceFile, node: ast.AST) -> ast.AST | None:
+    """The innermost enclosing function scope, or None at module level."""
+    for anc in src.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return anc
+    return None
+
+
+@register
+class AtomicPublishRule(Rule):
+    """Durable artifacts publish tmp→``os.replace`` — never directly.
+
+    A reader (resume, tmhealth, the fleet aggregator, a human) that
+    opens a half-written JSON file sees garbage; a crash between
+    truncate and flush *loses the previous good artifact too*.  The
+    proven idiom everywhere else in this repo (checkpoint manifests,
+    HEALTH.json, flight-recorder dumps, the lint report itself) is
+    write-to-``<path>.tmp`` then ``os.replace`` — crash-atomic on POSIX.
+
+    Heuristics, per function scope: a write-mode ``open()`` whose path
+    expression mentions ``.tmp`` (directly or via a name assigned in
+    the same function) is the idiom's first half and must be paired
+    with an ``os.replace`` in the same function; any other ``"w"``/
+    ``"x"`` open is a direct write; ``"a"`` opens are torn-tail-prone
+    appends.  Streams that provably tolerate torn tails (JSONL event
+    sinks, append-only audit logs — their readers skip unparseable
+    final lines) suppress with that justification:
+    ``# lint: atomic-publish-ok — <why torn reads are safe>``.
+    """
+
+    name = "atomic-publish"
+    severity = SEV_ERROR
+    description = ("durable-file write outside the tmp→os.replace idiom — "
+                   "fix or justify (JSONL torn-tail tolerance)")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        opens: list[tuple[ast.AST | None, ast.Call, str]] = []
+        replaced: set[ast.AST | None] = set()
+        assigns: dict[tuple[ast.AST | None, str], ast.AST] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                if self._is_open(node):
+                    mode = self._mode(node)
+                    if mode and mode[0] in "wxa":
+                        opens.append(
+                            (_nearest_function(src, node), node, mode))
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "replace"
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "os"):
+                    replaced.add(_nearest_function(src, node))
+            elif (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                scope = _nearest_function(src, node)
+                assigns[(scope, node.targets[0].id)] = node.value
+        for scope, call, mode in opens:
+            path = call.args[0] if call.args else None
+            if mode[0] == "a":
+                yield self.finding(
+                    src, call.lineno, call.col_offset,
+                    f"append-mode open({mode!r}) to a durable file — a "
+                    f"crash mid-write leaves a torn tail; if every reader "
+                    f"skips unparseable tails (JSONL), mark the line "
+                    f"'lint: atomic-publish-ok — <why>'")
+            elif self._tmpish(path, scope, assigns):
+                if scope not in replaced:
+                    yield self.finding(
+                        src, call.lineno, call.col_offset,
+                        "tmp file written but never published — pair the "
+                        ".tmp write with os.replace in the same function")
+            else:
+                yield self.finding(
+                    src, call.lineno, call.col_offset,
+                    f"direct open({mode!r}) write to a durable path — "
+                    f"write '<path>.tmp' then os.replace(tmp, path) so a "
+                    f"crash never tears the artifact or loses the "
+                    f"previous one")
+
+    def _is_open(self, call: ast.Call) -> bool:
+        return isinstance(call.func, ast.Name) and call.func.id == "open"
+
+    def _mode(self, call: ast.Call) -> str | None:
+        """The mode string when statically known, else None (skipped)."""
+        expr = None
+        if len(call.args) >= 2:
+            expr = call.args[1]
+        else:
+            for kw in call.keywords:
+                if kw.arg == "mode":
+                    expr = kw.value
+        if expr is None:
+            return "r"
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        return None
+
+    def _tmpish(self, path: ast.AST | None, scope: ast.AST | None,
+                assigns: dict) -> bool:
+        if path is None:
+            return False
+        for n in ast.walk(path):
+            if (isinstance(n, ast.Constant) and isinstance(n.value, str)
+                    and ".tmp" in n.value):
+                return True
+            if isinstance(n, ast.Name):
+                bound = assigns.get((scope, n.id))
+                if bound is not None and any(
+                        isinstance(m, ast.Constant)
+                        and isinstance(m.value, str) and ".tmp" in m.value
+                        for m in ast.walk(bound)):
+                    return True
+        return False
+
+
+@register
+class GuardedStateRule(Rule):
+    """Attribute assigned both under and outside ``with self._lock:``.
+
+    The PR 10 shape: ``FleetScheduler._sups`` was written by the
+    episode thread's callback and read by ``_preempt`` — one side held
+    the lock, the other didn't, and a preemption arriving in the gap
+    was silently lost.  In a class that owns a ``Lock``/``RLock``, an
+    attribute rebound both inside and outside lock-guarded code is that
+    bug waiting to recur.
+
+    What counts as guarded: a lexical ``with self.<lock>:`` ancestor,
+    or the whole body of a method whose every ``self.m()`` call site in
+    the class sits under the lock (the ``EventSink._rotate`` idiom —
+    helpers documented 'call with the lock held').  ``__init__`` is
+    exempt: construction precedes sharing.
+    """
+
+    name = "guarded-state"
+    severity = SEV_ERROR
+    description = ("attribute assigned both under and outside the owning "
+                   "class's lock — the registration-race shape")
+
+    _LOCK_CTORS = ("Lock", "RLock")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        for cls in ast.walk(src.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            lock_attrs = self._lock_attrs(cls)
+            if not lock_attrs:
+                continue
+            methods = {m.name: m for m in cls.body
+                       if isinstance(m, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            locked = self._locked_methods(src, methods, lock_attrs)
+            guarded: dict[str, list] = {}
+            unguarded: dict[str, list] = {}
+            for mname, m in methods.items():
+                if mname == "__init__":
+                    continue
+                for node in ast.walk(m):
+                    for attr, line, col in self._self_assigns(node):
+                        if attr in lock_attrs:
+                            continue
+                        bucket = (guarded if mname in locked
+                                  or self._under_lock(src, node, m,
+                                                      lock_attrs)
+                                  else unguarded)
+                        bucket.setdefault(attr, []).append((line, col))
+            for attr in sorted(set(guarded) & set(unguarded)):
+                for line, col in unguarded[attr]:
+                    yield self.finding(
+                        src, line, col,
+                        f"self.{attr} is assigned here without the lock "
+                        f"but under 'with self.{sorted(lock_attrs)[0]}:' "
+                        f"elsewhere in the class — every writer must "
+                        f"agree on whether the lock protects it")
+
+    def _lock_attrs(self, cls: ast.ClassDef) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            f = node.value.func
+            ctor = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            if ctor not in self._LOCK_CTORS:
+                continue
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    out.add(t.attr)
+        return out
+
+    def _self_assigns(self, node: ast.AST):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Tuple):
+                targets.extend(t.elts)
+            elif (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                yield t.attr, t.lineno, t.col_offset
+
+    def _is_lock_expr(self, expr: ast.AST, lock_attrs: set[str]) -> bool:
+        return (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and expr.attr in lock_attrs)
+
+    def _under_lock(self, src: SourceFile, node: ast.AST, method: ast.AST,
+                    lock_attrs: set[str]) -> bool:
+        for anc in src.ancestors(node):
+            if anc is method:
+                return False
+            if isinstance(anc, (ast.With, ast.AsyncWith)) and any(
+                    self._is_lock_expr(it.context_expr, lock_attrs)
+                    for it in anc.items):
+                return True
+        return False
+
+    def _locked_methods(self, src: SourceFile, methods: dict,
+                        lock_attrs: set[str]) -> set[str]:
+        """Methods whose every ``self.m()`` call site runs under the
+        lock (directly or from another such method) — their bodies
+        count as guarded.  One call site outside the lock disqualifies:
+        ambiguity is exactly the bug this rule exists to surface."""
+        sites: dict[str, list[bool]] = {}
+        for mname, m in methods.items():
+            for node in ast.walk(m):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                        and node.func.attr in methods):
+                    under = self._under_lock(src, node, m, lock_attrs)
+                    sites.setdefault(node.func.attr, []).append(
+                        under or mname)  # True, or the calling method
+        locked: set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for callee, callers in sites.items():
+                if callee in locked:
+                    continue
+                if all(c is True or c in locked for c in callers):
+                    locked.add(callee)
+                    changed = True
+        return locked
+
+
+@register
+class ThreadLifecycleRule(Rule):
+    """Every ``threading.Thread`` is named; non-daemon threads join.
+
+    An anonymous thread shows up as ``Thread-3`` in ``tmhealth``
+    blackbox dumps, the flight recorder, and py-spy — useless when
+    diagnosing exactly the hung-seam incidents those tools exist for.
+    And a non-daemon thread nobody joins outlives the run: the process
+    can't exit, the supervisor escalates to SIGKILL, and the crash
+    looks like a hang.  Daemon threads (all seven seams in this repo)
+    need only the name.
+    """
+
+    name = "thread-lifecycle"
+    severity = SEV_ERROR
+    description = ("threading.Thread must carry name=...; non-daemon "
+                   "threads need a reachable join()")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        has_join = any(
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "join"
+            and not self._path_or_str_join(n.func.value)
+            for n in ast.walk(src.tree))
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+                continue
+            kws = {k.arg: k.value for k in node.keywords if k.arg}
+            if "name" not in kws:
+                yield self.finding(
+                    src, node.lineno, node.col_offset,
+                    "unnamed thread — pass name='<seam>' so health "
+                    "dumps, the flight recorder and py-spy can identify "
+                    "it")
+            d = kws.get("daemon")
+            daemon = isinstance(d, ast.Constant) and d.value is True
+            if not daemon and not has_join:
+                yield self.finding(
+                    src, node.lineno, node.col_offset,
+                    "non-daemon thread with no join() anywhere in this "
+                    "file — it outlives the run and turns clean exits "
+                    "into apparent hangs; join it or make it a daemon")
+
+    def _path_or_str_join(self, value: ast.AST) -> bool:
+        """``os.path.join`` / ``"sep".join`` are not thread joins."""
+        if isinstance(value, ast.Constant):
+            return True
+        if isinstance(value, ast.Attribute) and value.attr == "path":
+            return True
+        return False
+
+
+#: Declared lock-ordering DAG (``layers.LAYER_DAG`` style): innermost
+#: locks first, and an entry may only allow inner locks declared EARLIER
+#: — so the declaration is acyclic by construction, exactly like the
+#: import DAG.  Entry: (name, (file-prefix, lock-attr), allowed-inner,
+#: reentrant).  The telemetry leaves allow NOTHING inside them — in
+#: particular ``health`` must never acquire ``sink``'s lock: the ticker
+#: releases the monitor's lock before emitting (the documented contract
+#: in ``telemetry/core.py:_health_tick``).  The fleet scheduler's RLock
+#: sits outermost: its passes emit telemetry while holding it, so the
+#: sink/flight/health locks may nest inside (that nesting is cross-file
+#: and runtime-only; the entry documents it for the day it becomes
+#: lexical).
+LOCK_ORDER_DAG: tuple = (
+    ("sink", ("theanompi_tpu/telemetry/sink.py", "_lock"), (), False),
+    ("flight", ("theanompi_tpu/telemetry/flight_recorder.py", "_lock"),
+     (), False),
+    ("health", ("theanompi_tpu/telemetry/health.py", "_lock"), (), False),
+    ("watchdog", ("theanompi_tpu/resilience/watchdog.py", "_lock"),
+     (), False),
+    ("data-hooks", ("theanompi_tpu/models/data/base.py", "_HOOKS_LOCK"),
+     (), False),
+    ("shm-busy", ("theanompi_tpu/models/data/shm_loader.py", "_busy"),
+     (), False),
+    ("native-build", ("theanompi_tpu/native/__init__.py", "_build_lock"),
+     (), False),
+    ("interleave", ("theanompi_tpu/analysis/interleave.py", "_cond"),
+     (), False),
+    ("scheduler", ("theanompi_tpu/fleet/scheduler.py", "_lock"),
+     ("sink", "flight", "health"), True),
+)
+
+
+def validate_lock_order(dag=None) -> None:
+    """Reject duplicate names and forward references, like
+    ``layers.validate_dag`` — an allowed-inner lock must be declared
+    earlier (further inward), which makes cycles unrepresentable."""
+    dag = LOCK_ORDER_DAG if dag is None else dag
+    seen: list[str] = []
+    for name, (prefix, attr), allowed, _reentrant in dag:
+        if name in seen:
+            raise ValueError(f"lock-order: duplicate lock name {name!r}")
+        if not prefix or not attr:
+            raise ValueError(f"lock-order: empty prefix/attr on {name!r}")
+        for a in allowed:
+            if a not in seen:
+                raise ValueError(
+                    f"lock-order: {name!r} allows {a!r} which is not "
+                    f"declared earlier — inner locks must be declared "
+                    f"first")
+        seen.append(name)
+
+
+@register
+class LockOrderRule(Rule):
+    """Nested ``with``-lock acquisitions obey :data:`LOCK_ORDER_DAG`.
+
+    Two threads taking the same two locks in opposite orders is the
+    classic deadlock; a declared global order makes it impossible.  The
+    check is lexical (same-file nested ``with`` statements, including
+    multi-item ``with a, b:`` read left-to-right): acquiring a declared
+    lock while holding another is legal only if the held lock's entry
+    allows it; re-acquiring a non-reentrant lock is flagged as a
+    self-deadlock.  Cross-file nesting (scheduler → telemetry emit) is
+    declared in the DAG for documentation but only runtime tools can
+    see it — the interleave harness exists for those.
+    """
+
+    name = "lock-order"
+    severity = SEV_ERROR
+    description = ("nested with-lock acquisition not allowed by the "
+                   "declared LOCK_ORDER_DAG")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        validate_lock_order()
+        decls = [(name, attr, set(allowed), reentrant)
+                 for name, (prefix, attr), allowed, reentrant
+                 in LOCK_ORDER_DAG if src.rel.startswith(prefix)]
+        if not decls:
+            return
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            held = self._held_above(src, node, decls)
+            for item in node.items:
+                acq = self._declared(item.context_expr, decls)
+                if acq is None:
+                    continue
+                aname, _allowed, _reent = acq
+                for hname, hallowed, hreent in held:
+                    if hname == aname:
+                        if not hreent:
+                            yield self.finding(
+                                src, item.context_expr.lineno,
+                                item.context_expr.col_offset,
+                                f"re-acquiring non-reentrant lock "
+                                f"{aname!r} while holding it — "
+                                f"self-deadlock")
+                    elif aname not in hallowed:
+                        yield self.finding(
+                            src, item.context_expr.lineno,
+                            item.context_expr.col_offset,
+                            f"acquiring lock {aname!r} while holding "
+                            f"{hname!r} — not allowed by LOCK_ORDER_DAG; "
+                            f"declare the order or restructure so the "
+                            f"locks never nest")
+                held.append(acq)
+
+    def _declared(self, expr: ast.AST, decls):
+        key = (expr.attr if isinstance(expr, ast.Attribute)
+               else expr.id if isinstance(expr, ast.Name) else None)
+        for name, attr, allowed, reentrant in decls:
+            if key == attr:
+                return (name, allowed, reentrant)
+        return None
+
+    def _held_above(self, src: SourceFile, node: ast.AST, decls) -> list:
+        held = []
+        for anc in src.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                break  # a nested def runs on its caller's schedule,
+                # not inside the enclosing with — out of lexical scope
+            if isinstance(anc, (ast.With, ast.AsyncWith)):
+                for item in anc.items:
+                    acq = self._declared(item.context_expr, decls)
+                    if acq is not None:
+                        held.append(acq)
+        return held

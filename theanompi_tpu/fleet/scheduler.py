@@ -27,6 +27,7 @@ import os
 import threading
 import time
 
+from theanompi_tpu.analysis.interleave import sp
 from theanompi_tpu.resilience import EXIT_CLEAN, EXIT_CRASH
 from theanompi_tpu.resilience.faults import FaultPlan
 from theanompi_tpu.resilience.supervisor import run_job
@@ -123,6 +124,7 @@ class FleetScheduler:
         was mid-flight when that scheduler died left a cadence
         checkpoint behind, so it re-enters as ``preempted`` and resumes
         elastically like any preemption victim."""
+        sp("fleet.adopt")
         with self._lock:
             if rec.spec.job_id in self.records:
                 raise JobSpecError(
@@ -141,6 +143,8 @@ class FleetScheduler:
     def _event(self, name: str, **fields) -> None:
         line = {"ts": time.time(),  # lint: wall-ok — audit log stamp
                 "event": name, **fields}
+        # lint: atomic-publish-ok — JSONL audit log; readers tolerate a
+        # torn final line (json.loads per line, bad tail skipped)
         with open(self.events_path, "a") as f:
             f.write(json.dumps(line) + "\n")
         if self._telemetry is not None:
@@ -223,6 +227,7 @@ class FleetScheduler:
         fit its gang.  Strict priority order — an unschedulable head
         blocks the pass (no backfill past it), so a big high-priority
         job cannot be starved by a stream of small ones."""
+        sp("fleet.pass")
         for spec in self.queue.ordered():
             rec = self.records[spec.job_id]
             n_min = int(spec.min_devices)
@@ -341,6 +346,7 @@ class FleetScheduler:
                 sup.terminate()
             if kill_child:
                 threading.Thread(target=self._kill_when_up, args=(sup,),
+                                 name=f"fleet-kill-{jid}",
                                  daemon=True).start()
 
         result = run_job(
@@ -350,6 +356,7 @@ class FleetScheduler:
             resilience_path=os.path.join(jdir, "resilience.json"),
             telemetry_dir=os.path.join(jdir, "telemetry"),
             env=env)
+        sp("fleet.episode.done")
         with self._lock:
             self.ledger.release(jid)
             self._episode_wall.pop(jid, None)

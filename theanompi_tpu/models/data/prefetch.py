@@ -117,7 +117,8 @@ class Prefetcher:
             finally:
                 put(_END)
 
-        self._thread = threading.Thread(target=work, daemon=True)
+        self._thread = threading.Thread(target=work, name="data-prefetch",
+                                        daemon=True)
         self._thread.start()
 
     def __iter__(self):

@@ -1186,6 +1186,9 @@ class BaseTrainer:
                             # so a resumed lineage's trace concatenates to
                             # exactly the uninterrupted sequence (the
                             # no-replay/no-skip assert in the e2e tests)
+                            # lint: atomic-publish-ok — append-only
+                            # witness lines; a torn tail IS the signal
+                            # (the killed step leaves no complete line)
                             with open(self._data_trace_path, "a") as tf:
                                 tf.write(
                                     f"{epoch} "

@@ -620,6 +620,9 @@ class Supervisor:
             os.makedirs(self.telemetry_dir, exist_ok=True)
             line = json.dumps({"ts": time.time(),  # lint: wall-ok — log
                                "kind": "instant", **event})  # stamp
+            # lint: atomic-publish-ok — JSONL audit stream; read_events
+            # skips a torn final line, and losing the tail on crash is
+            # exactly the crash being recorded
             with open(os.path.join(self.telemetry_dir,
                                    "supervisor.jsonl"), "a") as f:
                 f.write(line + "\n")

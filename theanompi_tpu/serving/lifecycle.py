@@ -31,6 +31,8 @@ class RequestLog:
         parent = os.path.dirname(path)
         if parent:
             os.makedirs(parent, exist_ok=True)
+        # lint: atomic-publish-ok — append-only JSONL request log; the
+        # harvest parses per line and drops an unparseable torn tail
         self._f = open(path, "a")
 
     def record(self, req) -> None:

@@ -116,8 +116,10 @@ def finalize(directory: str) -> dict:
     events = load_all_events(directory)
     write_chrome_trace(events, os.path.join(directory, "trace.json"))
     summary = summarize_events(events)
-    with open(os.path.join(directory, "summary.json"), "w") as f:
+    spath = os.path.join(directory, "summary.json")
+    with open(spath + ".tmp", "w") as f:
         json.dump(summary, f, indent=1)
+    os.replace(spath + ".tmp", spath)
     return summary
 
 

@@ -18,6 +18,7 @@ clock.
 from __future__ import annotations
 
 import json
+import os
 
 from theanompi_tpu.telemetry.sink import read_events
 
@@ -60,8 +61,9 @@ def write_chrome_trace(events: list[dict], out_path: str) -> str:
         "displayTimeUnit": "ms",
         "otherData": {"source": "theanompi_tpu.telemetry"},
     }
-    with open(out_path, "w") as f:
+    with open(out_path + ".tmp", "w") as f:
         json.dump(trace, f)
+    os.replace(out_path + ".tmp", out_path)
     return out_path
 
 

@@ -25,6 +25,9 @@ import threading
 import time
 from datetime import datetime, timezone
 
+# analysis.interleave is stdlib-only and sits at the bottom of the
+# import DAG — the one non-telemetry import the leaf wall permits
+from theanompi_tpu.analysis.interleave import sp
 from theanompi_tpu.telemetry.metrics import MetricsRegistry
 from theanompi_tpu.telemetry.sink import EventSink
 
@@ -194,6 +197,7 @@ class Telemetry:
     def _health_tick(self) -> None:
         from theanompi_tpu.telemetry.metrics import HEALTH_INSTANTS
 
+        sp("health.tick")
         changed = self.health.tick()
         for v in changed:
             # mirror severity *transitions* into the event stream (the
@@ -218,6 +222,7 @@ class Telemetry:
             pass  # lint: swallow-ok — advisory file; next tick retries
 
     def close(self) -> None:
+        sp("health.close")
         if self._health_thread is not None:
             self._health_stop.set()
             self._health_thread.join(timeout=5.0)

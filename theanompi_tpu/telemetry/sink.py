@@ -61,6 +61,8 @@ class EventSink:
         for stale in list(sink_files(directory, rank=rank)):
             if stale != self.path:
                 os.remove(stale)
+        # lint: atomic-publish-ok — live JSONL stream, not a publish:
+        # line-buffered appends, and read_events skips a torn tail
         self._f = open(self.path, "w", buffering=1)
         self._size = 0
 
@@ -85,6 +87,8 @@ class EventSink:
             os.replace(self.path, f"{self.path}.1")
         else:
             os.remove(self.path)
+        # lint: atomic-publish-ok — fresh generation of the live JSONL
+        # stream after rotation; same torn-tail-tolerant readers
         self._f = open(self.path, "w", buffering=1)
         self._size = 0
 

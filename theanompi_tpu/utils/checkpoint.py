@@ -108,6 +108,8 @@ from contextlib import nullcontext
 import jax
 import numpy as np
 
+from theanompi_tpu.analysis.interleave import sp
+
 #: manifest schema version (bump on incompatible change)
 MANIFEST_VERSION = 1
 
@@ -828,6 +830,9 @@ class Checkpointer:
         verify is worth its read cost."""
         if self._marked_dirty or self.read_only:
             return
+        # lint: atomic-publish-ok — one-byte existence marker; its
+        # PRESENCE is the signal, content never read, so a torn write
+        # still means exactly "a writer was here"
         with open(self._dirty_path(), "w") as f:
             f.write("1")
         self._marked_dirty = True
@@ -852,6 +857,7 @@ class Checkpointer:
         The in-flight slot is cleared before the potential raise, so a
         writer error is delivered exactly once — at the first join after it
         happened (the next save, a load, or trainer exit)."""
+        sp("ckpt.join")
         h, self._inflight = self._inflight, None
         if h is not None:
             h.join()
@@ -916,6 +922,7 @@ class Checkpointer:
             raise RuntimeError(
                 "Checkpointer is read-only (load_for_inference): save() "
                 "refused — the directory belongs to a training writer")
+        sp("ckpt.save")
         self.join_pending()
         tel = self.telemetry
         with (tel.span("checkpoint.snapshot", epoch=epoch)
@@ -956,6 +963,7 @@ class Checkpointer:
         async mode, inline in sync mode — one code path, so the published
         bytes, manifest included, are identical either way)."""
         t0 = time.perf_counter()
+        sp("ckpt.write.begin")
         fault = (self.fault_plan.fire("checkpoint", epoch)
                  if self.fault_plan is not None else None)
         if fault == "fail":
@@ -971,6 +979,7 @@ class Checkpointer:
             json.dump(manifest, f, sort_keys=True, indent=1)
         if self._pre_publish_hook is not None:
             self._pre_publish_hook(epoch)
+        sp("ckpt.write.publish")
         # manifest BEFORE the .npz: a published checkpoint must always have
         # its manifest (the reverse order would make every torn publish
         # read as a corrupt — manifest-less — checkpoint at resume)
@@ -991,6 +1000,7 @@ class Checkpointer:
         # files marked scrubbed) before the keep-n window is computed
         self._scrub_one()
         self._prune()
+        sp("ckpt.write.done")
         if self.telemetry is not None:
             dur = time.perf_counter() - t0
             nbytes = sum(int(a.nbytes) for a in flat.values())

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 
 import numpy as np
 
@@ -498,8 +499,9 @@ def main(argv=None):
                    "epsilon past it (VERDICT r4 #4)"
                ),
            }}
-    with open(args.out, "w") as f:
+    with open(args.out + ".tmp", "w") as f:
         json.dump(art, f, indent=1)
+    os.replace(args.out + ".tmp", args.out)
     print(json.dumps({"passed": art["passed"], "out": args.out}))
 
 

@@ -122,8 +122,9 @@ def main(argv=None):
         "(measured 1.0k img/s inline under a full test-suite run, i.e. "
         "starving) and costlier augmentations"
     )
-    with open(args.out, "w") as f:
+    with open(args.out + ".tmp", "w") as f:
         json.dump(art, f, indent=1)
+    os.replace(args.out + ".tmp", args.out)
     print(json.dumps({"verdict": art["verdict"], "out": args.out}))
 
 

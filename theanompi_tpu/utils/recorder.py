@@ -190,7 +190,8 @@ def write_history_snapshot(snapshot: dict, path: str) -> None:
             {k: np.asarray(v) for k, v in hist.items()},
             allow_pickle=True,
         )
-    with open(os.path.join(path, "summary.json"), "w") as f:
+    spath = os.path.join(path, "summary.json")
+    with open(spath + ".tmp", "w") as f:
         json.dump(
             {
                 "iters": len(snapshot.get("time", {}).get("calc", ())),
@@ -201,3 +202,4 @@ def write_history_snapshot(snapshot: dict, path: str) -> None:
             },
             f,
         )
+    os.replace(spath + ".tmp", spath)

@@ -393,8 +393,9 @@ def main(argv=None):
                        peak_flops=peak, peak_gbps=gbps)
     art["model"] = args.model
     art["platform"] = platform
-    with open(args.out, "w") as f:
+    with open(args.out + ".tmp", "w") as f:
         json.dump(art, f, indent=1)
+    os.replace(args.out + ".tmp", args.out)
     print(json.dumps({k: art[k] for k in
                       ("model", "device_step_ms", "total_gflops_per_step",
                        "total_bytes_gb_per_step", "comm_share",

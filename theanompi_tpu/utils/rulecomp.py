@@ -36,6 +36,7 @@ harness measures comm cost too.
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Any
 
@@ -194,8 +195,9 @@ def compare_rules(devices=8, model_config: dict | None = None,
         "results": rows,
     }
     if out_path:
-        with open(out_path, "w") as f:
+        with open(out_path + ".tmp", "w") as f:
             json.dump(artifact, f, indent=1)
+        os.replace(out_path + ".tmp", out_path)
     return artifact
 
 
@@ -313,8 +315,9 @@ def diagnose_easgd_tau(devices=8, model_config: dict | None = None,
                         verbose=verbose)
     art["diagnosis"] = _diagnose(art["results"])
     if out_path:
-        with open(out_path, "w") as f:
+        with open(out_path + ".tmp", "w") as f:
             json.dump(art, f, indent=1)
+        os.replace(out_path + ".tmp", out_path)
     return art
 
 

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import re
 import tempfile
 
@@ -300,8 +301,9 @@ def measure_scaling(
             "multi-chip slice."
         )
     if out_path:
-        with open(out_path, "w") as f:
+        with open(out_path + ".tmp", "w") as f:
             json.dump(artifact, f, indent=1)
+        os.replace(out_path + ".tmp", out_path)
     return artifact
 
 
@@ -406,8 +408,9 @@ def exchange_microbench(
                  "backend; step_ms is only meaningful on real chips"),
     }
     if out_path:
-        with open(out_path, "w") as f:
+        with open(out_path + ".tmp", "w") as f:
             json.dump(artifact, f, indent=1)
+        os.replace(out_path + ".tmp", out_path)
     return artifact
 
 
