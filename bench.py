@@ -376,6 +376,22 @@ def run_serve_bench() -> dict:
     return serve_cli.serve(args)
 
 
+def _ledger_append(payload: dict, source: str) -> None:
+    """ISSUE 16: append one published artifact to PERF_LEDGER.jsonl next
+    to this file — every publish site calls through here (including the
+    backend_unavailable stub, which the ledger records but never
+    baselines).  BENCH_LEDGER overrides the path; BENCH_LEDGER=0
+    disables; never raises."""
+    try:
+        from theanompi_tpu.telemetry.ledger import bench_ledger_append
+
+        bench_ledger_append(
+            payload, source,
+            repo_dir=os.path.dirname(os.path.abspath(__file__)))
+    except Exception:  # lint: swallow-ok — advisory trajectory, bench line wins
+        pass
+
+
 def _measure():
     """One full measurement pass: primary line + transformer side artifact."""
     if os.environ.get("BENCH_COMPILE_CACHE"):
@@ -397,6 +413,7 @@ def _measure():
         with open(path + ".tmp", "w") as f:
             json.dump(out, f, indent=1)
         os.replace(path + ".tmp", path)
+        _ledger_append(out, "SERVE.json")
         print(json.dumps(out))
         return
     model_name = os.environ.get("BENCH_MODEL", "resnet50")
@@ -431,6 +448,7 @@ def _measure():
     # overrides (BENCH_BS/BENCH_FUSED_LOSS/...) would measure an off-label
     # config, so those knobs are scrubbed for the side run.
     print(json.dumps(out))
+    _ledger_append(out, f"bench.{model_name}")
     if "BENCH_MODEL" in os.environ or os.environ.get("BENCH_SKIP_EXTRA"):
         return
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -452,6 +470,7 @@ def _measure():
         with open(path + ".tmp", "w") as f:
             json.dump(extra, f, indent=1)
         os.replace(path + ".tmp", path)
+        _ledger_append(extra, "BENCH_transformer.json")
     except Exception as e:  # lint: swallow-ok — the primary bench line
         # must survive a side-bench failure; the error is printed, not lost
         print(f"transformer side-bench failed: {e}", file=sys.stderr)
@@ -617,6 +636,7 @@ def main():
             with open(stub_path + ".tmp", "w") as f:
                 json.dump(stub, f, indent=1)
             os.replace(stub_path + ".tmp", stub_path)
+            _ledger_append(stub, os.path.basename(stub_path))
             # SystemExit's string arg is printed to stderr by the
             # interpreter — no explicit print, or the line doubles
             raise SystemExit(unavailable)

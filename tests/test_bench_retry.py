@@ -32,6 +32,9 @@ def _env(**extra):
         "BENCH_STEPS": "2",
         "BENCH_TRIALS": "1",
         "BENCH_RETRY_BACKOFF": "0",
+        # a test bench run must not append to the repo's committed
+        # perf trajectory (ISSUE 16)
+        "BENCH_LEDGER": "0",
     })
     env.update({k: str(v) for k, v in extra.items()})
     # a stale attempt counter inherited from the runner would skew the test

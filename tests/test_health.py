@@ -733,3 +733,79 @@ def test_launcher_hang_is_detected_killed_and_restarted(tmp_path,
     assert any(v["detector"] == "hang" and v["severity"] == "critical"
                for v in first["health"])
     assert first["blackbox"]["reason"] == "hang"
+
+
+# -- perf ledger detector (ISSUE 16) ------------------------------------------
+
+def _perf_ledger(path, values):
+    from theanompi_tpu.telemetry.ledger import PerfLedger, make_record
+
+    led = PerfLedger(str(path))
+    led.append([make_record("seed", "bench", "bench.imgs_per_sec", v,
+                            "images/sec", run_id=f"r{i}")
+                for i, v in enumerate(values)])
+    return led
+
+
+def test_perf_detector_warns_on_ledger_regression(tmp_path):
+    ledger = tmp_path / "PERF_LEDGER.jsonl"
+    _perf_ledger(ledger, [100.0, 101.0, 99.0, 100.0, 70.0])
+    mon = _mon(tmp_path, perf_ledger_path=str(ledger),
+               hang_warmup_steps=99)
+    mon.tick(now=1.0)
+    v = _by_detector(mon.verdicts())["perf"]
+    assert v["severity"] == "warn"
+    assert "bench.imgs_per_sec" in v["reason"]
+    assert "-30" in v["reason"]  # the worst delta is stated
+
+
+def test_perf_detector_clears_on_recovery(tmp_path):
+    ledger = tmp_path / "PERF_LEDGER.jsonl"
+    led = _perf_ledger(ledger, [100.0, 101.0, 99.0, 100.0, 70.0])
+    mon = _mon(tmp_path, perf_ledger_path=str(ledger),
+               hang_warmup_steps=99)
+    mon.tick(now=1.0)
+    assert _by_detector(mon.verdicts())["perf"]["severity"] == "warn"
+    # a recovered point lands; force a distinct mtime so the gate reopens
+    from theanompi_tpu.telemetry.ledger import make_record
+
+    led.append([make_record("seed", "bench", "bench.imgs_per_sec", 100.0,
+                            "images/sec", run_id="r5")])
+    os.utime(str(ledger), (1.0, 2.0))
+    mon.tick(now=2.0)
+    assert _by_detector(mon.verdicts())["perf"]["severity"] == "ok"
+
+
+def test_perf_detector_mtime_gated(tmp_path, monkeypatch):
+    """An armed detector costs one stat per tick — the ledger is only
+    re-read when its mtime moves."""
+    ledger = tmp_path / "PERF_LEDGER.jsonl"
+    _perf_ledger(ledger, [100.0, 100.0])
+    os.utime(str(ledger), (1.0, 1.0))
+    mon = _mon(tmp_path, perf_ledger_path=str(ledger),
+               hang_warmup_steps=99)
+    mon.tick(now=1.0)
+    calls = []
+    import theanompi_tpu.telemetry.ledger as ledger_mod
+
+    real = ledger_mod.check_ledger
+    monkeypatch.setattr(ledger_mod, "check_ledger",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    mon.tick(now=2.0)
+    mon.tick(now=3.0)
+    assert calls == []  # unchanged mtime -> no re-read
+    os.utime(str(ledger), (1.0, 9.0))
+    mon.tick(now=4.0)
+    assert calls == [1]
+
+
+def test_perf_detector_off_without_ledger(tmp_path):
+    # unconfigured (default): detector never appears
+    mon = _mon(tmp_path, hang_warmup_steps=99)
+    mon.tick(now=1.0)
+    assert "perf" not in _by_detector(mon.verdicts())
+    # configured but no ledger file yet: stays silent, does not raise
+    mon = _mon(tmp_path, perf_ledger_path=str(tmp_path / "nope.jsonl"),
+               hang_warmup_steps=99)
+    mon.tick(now=1.0)
+    assert "perf" not in _by_detector(mon.verdicts())

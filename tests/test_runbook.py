@@ -468,3 +468,44 @@ def test_runbook_fleet_command(tmp_path, monkeypatch, subproc_compile_cache):
     names = [e["event"] for e in read_fleet_events(d)]
     assert names.count("fleet.schedule") == 2
     assert names.count("fleet.complete") == 2
+
+
+def test_runbook_tmprof_command(tmp_path, capsys):
+    """BASELINE step 9 (ISSUE 16): the exact `tmprof ./telemetry` and
+    `tmprof --ledger update/check` invocations.  The attribution table
+    must come from a real telemetry dir (segments partitioning the
+    window), the update must ingest a RUNBOOK artifact, and the check
+    over the repo's committed, backfilled PERF_LEDGER.jsonl must exit 0
+    — the acceptance's no-false-regression half."""
+    from theanompi_tpu.telemetry import Telemetry
+    from theanompi_tpu.telemetry import prof
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tel_dir = str(tmp_path / "telemetry")
+    tel = Telemetry(tel_dir, rank=0, profile=True)
+    t = 100.0
+    for step in range(3):
+        tel.emit_span("recorder.wait", t, 0.004)
+        t += 0.004
+        tel.emit_span("train.step", t, 0.02, step=step)
+        t += 0.02
+        tel.emit_span("exchange.overlap", t, 0.002)
+        t += 0.002
+    tel.close()
+
+    rc = prof.main([tel_dir])
+    out = capsys.readouterr().out
+    assert rc == 0, out  # compute-bound synthetic window: no host verdict
+    assert "rank 0" in out and "[train]" in out and "verdict:" in out
+
+    ledger = str(tmp_path / "PERF_LEDGER.jsonl")
+    attrib = os.path.join(tel_dir, "ATTRIB.json")
+    assert os.path.exists(attrib)  # close() published it
+    rc = prof.main(["--ledger", "update", attrib, "--ledger-path", ledger])
+    assert rc == 0
+    assert "ingested" in capsys.readouterr().out
+
+    rc = prof.main(["--ledger", "check", "--ledger-path",
+                    os.path.join(repo, "PERF_LEDGER.jsonl")])
+    capsys.readouterr()
+    assert rc == 0, "repo's committed perf ledger reads as regressed"

@@ -158,7 +158,10 @@ FLEET_FORBIDDEN_IMPORTS = (
 LEAF_SUBPACKAGES = {
     # telemetry may additionally reach the stdlib-only sync-point module
     # (ISSUE 15: the health ticker is an instrumented seam) — interleave
-    # imports nothing in-package, so the leaf stays cycle-free
+    # imports nothing in-package, so the leaf stays cycle-free.  ISSUE
+    # 16's profile.py (step attribution), ledger.py (perf trajectory) and
+    # prof.py (the tmprof CLI) live INSIDE this leaf: they import only
+    # telemetry siblings, so the wall holds unchanged
     f"{PKG}.telemetry": (f"{PKG}.telemetry", f"{PKG}.analysis.interleave"),
     # resilience may reach telemetry (ISSUE 13: registered event names +
     # the watchdog's flight-recorder dump) — still downward-only, so the

@@ -746,6 +746,11 @@ class DataDeterminismRule(Rule):
 REGISTERED_NAME_PREFIXES = (
     "theanompi_tpu/serving/",
     "theanompi_tpu/resilience/",
+    # ISSUE 16: the attribution/ledger emitters live by the same contract
+    # (their attr.*/prof.*/ledger.* names are registered in metrics.py)
+    "theanompi_tpu/telemetry/profile.py",
+    "theanompi_tpu/telemetry/ledger.py",
+    "theanompi_tpu/telemetry/prof.py",
 )
 
 #: emission entry points whose FIRST positional argument is an event name
@@ -1125,6 +1130,11 @@ LOCK_ORDER_DAG: tuple = (
     ("sink", ("theanompi_tpu/telemetry/sink.py", "_lock"), (), False),
     ("flight", ("theanompi_tpu/telemetry/flight_recorder.py", "_lock"),
      (), False),
+    # ISSUE 16: both leaf locks — the attributor computes under its lock
+    # and emits only after release; the ledger's lock guards the
+    # append+dedup read-modify-write and never wraps another lock
+    ("attrib", ("theanompi_tpu/telemetry/profile.py", "_lock"), (), False),
+    ("ledger", ("theanompi_tpu/telemetry/ledger.py", "_lock"), (), False),
     ("health", ("theanompi_tpu/telemetry/health.py", "_lock"), (), False),
     ("watchdog", ("theanompi_tpu/resilience/watchdog.py", "_lock"),
      (), False),

@@ -153,7 +153,13 @@ def build_parser() -> argparse.ArgumentParser:
                    "(HEALTH.json verdicts — watch with tmhealth) and the "
                    "crash flight recorder (blackbox.json); tune/disable "
                    "via --rule-set telemetry_health=... / "
-                   "telemetry_blackbox=N (ISSUE 13).  Under --supervise "
+                   "telemetry_blackbox=N (ISSUE 13).  Step-time "
+                   "attribution (attr.* gauges + ATTRIB.json — inspect "
+                   "with tmprof) rides the same opt-in; disable via "
+                   "--rule-set telemetry_profile=False, and open a "
+                   "bounded jax.profiler device-trace window with "
+                   "--rule-set profile_dir=DIR profile_window=START:STOP "
+                   "(ISSUE 16).  Under --supervise "
                    "a critical hang verdict kills and restarts the child "
                    "without waiting out --hang-timeout")
     p.add_argument("--checkpoint-dir", default=None)

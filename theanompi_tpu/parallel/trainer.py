@@ -305,6 +305,15 @@ def require_data_parallel_mesh(mesh, rule_name: str) -> None:
             )
 
 
+def _parse_profile_window(value) -> tuple:
+    """ISSUE 16: normalize the ``profile_window`` rule key (tuple or the
+    launcher's ``START:STOP`` string) — lazy import keeps the telemetry
+    layer off the import path of telemetry-less runs."""
+    from theanompi_tpu.telemetry.profile import parse_profile_window
+
+    return parse_profile_window(value)
+
+
 def stack_for_workers(mesh, tree, n: int):
     """Tile a pytree with a leading worker axis sharded over ``data``.
 
@@ -735,6 +744,7 @@ class BaseTrainer:
         if not self._profiling and start <= self.iteration < stop:
             jax.profiler.start_trace(self.profile_dir)
             self._profiling = True
+            self._profile_mark("start")
         elif self._profiling and self.iteration >= stop:
             self._profile_stop()
 
@@ -742,6 +752,17 @@ class BaseTrainer:
         jax.block_until_ready(jax.tree.leaves(self.params))
         jax.profiler.stop_trace()
         self._profiling = False
+        self._profile_mark("stop")
+
+    def _profile_mark(self, phase: str) -> None:
+        """ISSUE 16: stamp the trace window into the event stream so the
+        device trace aligns with the host spans in one timeline."""
+        if self.telemetry is None:
+            return
+        from theanompi_tpu.telemetry.metrics import PROF_INSTANTS
+
+        self.telemetry.instant(PROF_INSTANTS[0], phase=phase,
+                               iteration=self.iteration)
 
     # -- telemetry (ISSUE 1) -------------------------------------------------
     def exchange_wire_bytes(self) -> int | None:
@@ -838,6 +859,10 @@ class BaseTrainer:
         if mem:
             for k, v in mem.items():
                 tel.gauge(f"device.{k}", v)
+        # ISSUE 16: attr.* segment gauges + per-device HBM watermarks +
+        # ATTRIB.json refresh, all at this fenced boundary (no-op unless
+        # the attributor was configured)
+        tel.profile_flush(step=self.iteration)
         tel.flush_metrics(step=self.iteration, window_steps=r.print_freq)
 
     # -- iteration (reference train_iter/val_iter) ---------------------------
@@ -1417,7 +1442,11 @@ class Rule:
             # ISSUE 8: open the elastic reshard gate (--resume-reshard)
             resume_reshard=bool(self.config.get("resume_reshard", False)),
             profile_dir=self.config.get("profile_dir"),
-            profile_window=tuple(self.config.get("profile_window", (10, 20))),
+            # ISSUE 16: parse, don't tuple() — a launcher-provided
+            # ``--rule-set profile_window=10:20`` string would otherwise
+            # silently become a 5-char tuple and never open the window
+            profile_window=_parse_profile_window(
+                self.config.get("profile_window", (10, 20))),
             telemetry=self.make_telemetry(),
             # ISSUE 4: fault_plan / sentinel_* / watchdog* / heartbeat_path /
             # handle_preemption / prefetch_stall_timeout rule keys (see
@@ -1448,6 +1477,10 @@ class Rule:
             health=self.config.get("telemetry_health", True),
             flight_recorder=int(
                 self.config.get("telemetry_blackbox", 256) or 0),
+            # ISSUE 16: step-time attribution defaults ON with telemetry
+            # (``telemetry_profile=False`` opts out); publishes ``attr.*``
+            # gauges + ATTRIB.json from the existing event stream
+            profile=self.config.get("telemetry_profile", True),
         )
 
     def adjust_model_config(self, model_config: dict, n_workers: int) -> None:

@@ -236,8 +236,11 @@ def serve(args) -> dict:
         health: bool | dict = True
         if args.slo_ttft_ms is not None:
             health = {"slo_ttft_p99_ms": float(args.slo_ttft_ms)}
+        # ISSUE 16: serve-step attribution (queue-wait/prefill/decode/
+        # rollout-swap) rides the same opt-in; ATTRIB.json lands in the
+        # telemetry dir at close
         telemetry = Telemetry(args.telemetry_dir, health=health,
-                              flight_recorder=256)
+                              flight_recorder=256, profile=True)
 
     fault_plan = FaultPlan.from_spec(None)  # THEANOMPI_FAULT_PLAN env
     try:

@@ -19,8 +19,16 @@ which emitted structured events.  This package is the common substrate:
   ``HEALTH.json`` (ISSUE 13);
 - :mod:`~theanompi_tpu.telemetry.flight_recorder` — bounded in-memory
   event ring dumped as ``blackbox.json`` on crash/SIGTERM;
-- :mod:`~theanompi_tpu.telemetry.cli` — the ``tmhealth`` CLI
-  (``python -m theanompi_tpu.telemetry``).
+- :mod:`~theanompi_tpu.telemetry.profile` — streaming step-time
+  attribution (data/compute/comm/validate/checkpoint/host for training,
+  queue-wait/prefill/decode/rollout-swap for serving) publishing
+  ``attr.*`` gauges, per-device HBM watermarks, and ``ATTRIB.json``
+  (ISSUE 16);
+- :mod:`~theanompi_tpu.telemetry.ledger` — the append-only
+  ``PERF_LEDGER.jsonl`` cross-run perf trajectory with typed regression
+  verdicts (ISSUE 16);
+- :mod:`~theanompi_tpu.telemetry.cli` / ``.prof`` — the ``tmhealth`` and
+  ``tmprof`` CLIs (``python -m theanompi_tpu.telemetry``).
 
 Everything is off by default: the trainer holds ``telemetry=None`` unless
 a sink was configured (``telemetry_dir`` rule config / ``--telemetry-dir``
@@ -40,12 +48,24 @@ from theanompi_tpu.telemetry.health import (
     read_health,
     replay_events,
 )
+from theanompi_tpu.telemetry.ledger import (
+    PerfLedger,
+    check_ledger,
+    read_ledger,
+)
 from theanompi_tpu.telemetry.metrics import (
     MetricsRegistry,
     device_memory_stats,
     mfu,
     peak_flops,
+    per_device_memory_stats,
     step_flops_estimate,
+)
+from theanompi_tpu.telemetry.profile import (
+    StepAttributor,
+    attribute_events,
+    parse_profile_window,
+    read_attrib,
 )
 from theanompi_tpu.telemetry.sink import (
     EventSink,
@@ -60,15 +80,23 @@ __all__ = [
     "HealthConfig",
     "HealthMonitor",
     "MetricsRegistry",
+    "PerfLedger",
     "Span",
+    "StepAttributor",
     "Telemetry",
+    "attribute_events",
+    "check_ledger",
     "device_memory_stats",
     "hung_verdict",
     "mfu",
+    "parse_profile_window",
     "peak_flops",
+    "per_device_memory_stats",
+    "read_attrib",
     "read_blackbox",
     "read_events",
     "read_health",
+    "read_ledger",
     "replay_events",
     "sink_files",
     "step_flops_estimate",

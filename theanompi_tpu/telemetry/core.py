@@ -79,7 +79,8 @@ class Telemetry:
 
     def __init__(self, directory: str, rank: int | None = None,
                  host: str | None = None, max_bytes: int = 32 * 2**20,
-                 keep: int = 3, health=None, flight_recorder: int = 0):
+                 keep: int = 3, health=None, flight_recorder: int = 0,
+                 profile=None):
         if rank is None:
             try:
                 import jax
@@ -100,6 +101,7 @@ class Telemetry:
         # HealthConfig overrides; ``flight_recorder`` is the ring capacity.
         self.flight = None
         self.health = None
+        self.prof = None
         self._health_stop: threading.Event | None = None
         self._health_thread: threading.Thread | None = None
         if flight_recorder:
@@ -121,6 +123,12 @@ class Telemetry:
                 target=self._health_loop, name="telemetry-health",
                 daemon=True)
             self._health_thread.start()
+        if profile:
+            # ISSUE 16 step attribution: same off-means-off contract — a
+            # Telemetry constructed the pre-16 way makes zero calls here
+            from theanompi_tpu.telemetry.profile import StepAttributor
+
+            self.prof = StepAttributor(directory, rank=rank)
         self.emit("meta", "session",
                   wall_time=datetime.now(timezone.utc).isoformat(),
                   host=self.host, pid=os.getpid())
@@ -136,6 +144,8 @@ class Telemetry:
             self.flight.record(event)
         if self.health is not None:
             self.health.observe(event)
+        if self.prof is not None:
+            self.prof.observe(event)
 
     def emit_span(self, name: str, t0: float, dur: float, **tags) -> None:
         self.emit("span", name, ts=t0, dur=dur,
@@ -174,6 +184,26 @@ class Telemetry:
             snap["step"] = step
         snap.update(extra)
         self.emit("metrics", "metrics", **snap)
+
+    def profile_flush(self, step: int | None = None) -> None:
+        """Publish the attribution gauges + HBM watermarks and refresh
+        ``ATTRIB.json`` — called at the trainer's fenced print boundary
+        (ISSUE 16).  No-op unless ``profile=`` was configured.
+
+        Gauge values are computed before any emission, so the attributor
+        never holds its lock across an emit (lock-order discipline: no
+        nesting with the sink's lock).
+        """
+        if self.prof is None:
+            return
+        gauges = dict(self.prof.gauges())
+        gauges.update(self.prof.sample_memory())
+        for name, value in gauges.items():
+            self.gauge(name, value, step=step)
+        try:
+            self.prof.write()
+        except OSError:
+            pass  # lint: swallow-ok — advisory file; next flush retries
 
     def export_chrome_trace(self, path: str | None = None) -> str:
         """Write this rank's events as a Chrome trace-event JSON file."""
@@ -229,6 +259,14 @@ class Telemetry:
             self._health_thread = None
         self.flush_metrics()
         self.emit("meta", "session_end")
+        if self.prof is not None:
+            # final attribution summary: the per-run artifact the perf
+            # ledger ingests (written before the sink closes so the last
+            # buffered spans are counted)
+            try:
+                self.prof.write()
+            except OSError:
+                pass  # lint: swallow-ok — advisory file at shutdown
         if self.health is not None:
             # final publish AFTER session_end so the file's last word is
             # the disarmed, end-of-run state

@@ -99,6 +99,12 @@ class HealthConfig:
     #: CRITICAL verdict (not just a warn) — the serving rollout watcher's
     #: probation window rolls back on it
     slo_critical_factor: float = 2.0
+    #: ISSUE 16: watch a ``PERF_LEDGER.jsonl`` for typed regression
+    #: verdicts (``telemetry/ledger.py``) — None keeps the detector off.
+    #: The file is re-checked only when its mtime moves, so an armed
+    #: detector costs one ``stat`` per tick.
+    perf_ledger_path: str | None = None
+    perf_tolerance: float = 0.10
 
 
 def _median(xs) -> float:
@@ -143,6 +149,9 @@ class HealthMonitor:
         # checkpoint state
         self._last_ckpt: float | None = None
         self._steps_at_ckpt = 0
+        # perf-ledger state (ISSUE 16): mtime cache so an unchanged
+        # ledger costs one stat per tick, not a reparse
+        self._perf_mtime: float | None = None
 
     # -- ingestion -----------------------------------------------------------
     def observe(self, event: dict, now: float | None = None) -> None:
@@ -289,6 +298,37 @@ class HealthMonitor:
             self._set("throughput", SEV_OK, "throughput holding baseline",
                       step=self._last_step, fields=fields)
 
+    def _eval_perf(self) -> None:
+        """ISSUE 16 perf detector: mirror the ledger's typed regression
+        verdicts as a live ``warn``.  The check is the lock-free
+        :func:`~theanompi_tpu.telemetry.ledger.check_ledger` read — no
+        ledger lock ever nests inside the health lock."""
+        cfg = self.config
+        if cfg.perf_ledger_path is None:
+            return
+        try:
+            mtime = os.path.getmtime(cfg.perf_ledger_path)
+        except OSError:
+            return  # no ledger yet — the detector stays silent
+        if mtime == self._perf_mtime:
+            return
+        self._perf_mtime = mtime
+        from theanompi_tpu.telemetry.ledger import check_ledger, regressions
+
+        bad = regressions(check_ledger(cfg.perf_ledger_path,
+                                       tolerance=cfg.perf_tolerance))
+        if bad:
+            worst = max(bad, key=lambda v: abs(v.get("delta_pct") or 0.0))
+            self._set("perf", SEV_WARN,
+                      f"{len(bad)} perf metric(s) regressed past "
+                      f"{cfg.perf_tolerance:.0%}: {worst['metric']} "
+                      f"{worst['delta_pct']:+.1f}% vs trailing median",
+                      fields={"regressed": [v["metric"] for v in bad],
+                              "worst_delta_pct": worst["delta_pct"],
+                              "tolerance_pct": worst["tolerance_pct"]})
+        else:
+            self._set("perf", SEV_OK, "no perf regressions in ledger")
+
     def _set(self, detector: str, severity: str, reason: str,
              step: int | None = None, fields: dict | None = None) -> None:
         self._verdicts[detector] = Verdict(
@@ -320,6 +360,7 @@ class HealthMonitor:
                           f"{now - self._last_ckpt:.0f}s",
                           fields={"since_s": round(now - self._last_ckpt, 1),
                                   "deadline_s": cfg.checkpoint_deadline_s})
+            self._eval_perf()
             changed = []
             for det, v in self._verdicts.items():
                 key = (v.severity, v.reason)

@@ -1,0 +1,441 @@
+"""Durable perf-regression ledger (ISSUE 16).
+
+Every perf artifact this repo produces — the ``BENCH_*.json`` rounds,
+``SCALING.json``, ``EXCHANGE*``/``SERVE.json`` reports, each run's
+``ATTRIB.json`` — is a write-once snapshot: round 3's 2481 images/sec
+says nothing about whether round 6 regressed.  :class:`PerfLedger` turns
+them into one append-only trajectory:
+
+- ``PERF_LEDGER.jsonl`` — one normalized record per measurement,
+  appended (never rewritten) with a line-granular crash contract: a torn
+  final line is skipped on read, everything before it survives.  Each
+  record carries a content fingerprint so re-ingesting the same artifact
+  (a re-run backfill, bench.py retrying) is idempotent.
+- ``PERF_LEDGER.json`` — an atomically-replaced (tmp + ``os.replace``)
+  per-metric summary snapshot for dashboards that want one file.
+- ``check()`` — typed regression verdicts per metric: the latest point
+  vs the trailing median of the previous ``window`` points, with the
+  tolerance stated in the verdict.  Direction is inferred from the unit
+  (``ms`` down is good, ``/sec``/``mfu``/``efficiency`` up is good).
+  ``backend_unavailable`` stub runs are *recorded* (the trajectory shows
+  the gap) but never enter a baseline and never regress.
+
+Consumers: ``bench.py`` appends at every publish site, ``tmprof
+--ledger`` drives update/check/backfill from the CLI, and the
+HealthMonitor's ``perf`` detector surfaces regressions as live ``warn``
+verdicts (ISSUE 13 plumbing, new detector).
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+import threading
+import time
+
+LEDGER_FILENAME = "PERF_LEDGER.jsonl"
+SNAPSHOT_FILENAME = "PERF_LEDGER.json"
+
+#: default trailing-median window and relative tolerance for check()
+DEFAULT_WINDOW = 5
+DEFAULT_TOLERANCE = 0.10
+
+#: artifact glob patterns backfill() ingests, in trajectory order —
+#: sorted() within a pattern keeps BENCH_r01..r05 chronological
+BACKFILL_PATTERNS = ("BENCH_r*.json", "BENCH_mfu_ladder.json",
+                     "BENCH_transformer.json", "BENCH_unavailable.json",
+                     "SCALING*.json", "EXCHANGE*.json", "SERVE*.json",
+                     "ATTRIB.json")
+
+#: unit substrings that mean lower-is-better; everything else (rates,
+#: mfu, efficiency, shares) improves upward
+_LOWER_BETTER_UNITS = ("ms", "seconds")
+
+
+def _fingerprint(record: dict) -> str:
+    """Content hash over the identity fields — the idempotency key."""
+    ident = {k: record.get(k) for k in
+             ("source", "kind", "metric", "run_id", "value")}
+    return hashlib.sha1(
+        json.dumps(ident, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def make_record(source: str, kind: str, metric: str | None,
+                value: float | None, unit: str = "",
+                run_id: str | None = None, **extra) -> dict:
+    rec = {
+        "schema": 1,
+        # wall stamp: trajectories correlate runs across machines/processes
+        "ts": time.time(),  # lint: wall-ok — cross-process trajectory stamp
+        "source": source,
+        "kind": kind,
+        "metric": metric,
+        "value": None if value is None else float(value),
+        "unit": unit,
+        "run_id": run_id,
+    }
+    if extra:
+        rec["extra"] = extra
+    rec["fp"] = _fingerprint(rec)
+    return rec
+
+
+def lower_is_better(metric: str, unit: str) -> bool:
+    m = (metric or "").lower()
+    u = (unit or "").lower()
+    if m.endswith("_ms") or "step_ms" in m or "ttft" in m or "latency" in m:
+        return True
+    return any(x == u or u.endswith(x) for x in _LOWER_BETTER_UNITS)
+
+
+# -- artifact classifiers ----------------------------------------------------
+
+def _bench_line_records(source: str, line: dict,
+                        prefix: str = "") -> list[dict]:
+    """Records out of one bench primary-output dict (the ``{"metric":
+    ..., "value": ...}`` line bench.py prints and re-publishes)."""
+    metric = line.get("metric")
+    if metric is None:
+        return []
+    recs = [make_record(source, "bench", prefix + metric,
+                        line.get("value"), line.get("unit", ""),
+                        run_id=line.get("run_id"),
+                        vs_baseline=line.get("vs_baseline"))]
+    if line.get("step_ms") is not None:
+        recs.append(make_record(source, "bench",
+                                f"{prefix}{metric}.step_ms",
+                                line["step_ms"], "ms",
+                                run_id=line.get("run_id")))
+    if line.get("mfu") is not None:
+        recs.append(make_record(source, "bench", f"{prefix}{metric}.mfu",
+                                line["mfu"], "mfu",
+                                run_id=line.get("run_id")))
+    return recs
+
+
+def classify_artifact(name: str, payload: dict) -> list[dict]:
+    """Normalize one known artifact into ledger records.
+
+    Unknown shapes yield nothing rather than noise — the ledger only
+    tracks metrics something can be held to.
+    """
+    if not isinstance(payload, dict):
+        return []
+    base = os.path.basename(name)
+    run_id = payload.get("run_id")
+    # deterministic backend-absence stubs: recorded, never baselined
+    if payload.get("status") == "backend_unavailable":
+        return [make_record(base, "backend_unavailable", None, None,
+                            run_id=run_id, error=payload.get("error"))]
+    # BENCH_rNN.json: a driver wrapper {n, cmd, rc, tail, parsed}
+    if "parsed" in payload and "rc" in payload:
+        parsed = payload.get("parsed")
+        if not parsed or payload.get("rc"):
+            return [make_record(base, "backend_unavailable", None, None,
+                                run_id=run_id, rc=payload.get("rc"))]
+        return _bench_line_records(base, parsed)
+    # BENCH_transformer.json / a bare bench line
+    if "metric" in payload and "value" in payload:
+        return _bench_line_records(base, payload)
+    # BENCH_mfu_ladder.json: {what, rows: [{dim, n_layers, batch, ...}]}
+    if base.startswith("BENCH_") and isinstance(payload.get("rows"), list):
+        recs = []
+        for row in payload["rows"]:
+            if not isinstance(row, dict):
+                continue
+            key = f"mfu_ladder.d{row.get('dim')}xL{row.get('n_layers')}"
+            if row.get("tokens_per_sec") is not None:
+                recs.append(make_record(base, "bench",
+                                        f"{key}.tokens_per_sec",
+                                        row["tokens_per_sec"], "tokens/sec",
+                                        run_id=run_id))
+            if row.get("mfu") is not None:
+                recs.append(make_record(base, "bench", f"{key}.mfu",
+                                        row["mfu"], "mfu", run_id=run_id))
+        return recs
+    # SCALING.json: {model, strategy, per_n: {n: {...}}}
+    if "per_n" in payload:
+        recs = []
+        model = payload.get("model", "model")
+        strat = payload.get("strategy", "")
+        for n, row in sorted(payload["per_n"].items(),
+                             key=lambda kv: int(kv[0])):
+            if not isinstance(row, dict):
+                continue
+            key = f"scaling.{model}.{strat}.n{n}"
+            for field, unit in (("imgs_per_sec", "images/sec"),
+                                ("efficiency", "efficiency"),
+                                ("step_ms", "ms")):
+                if row.get(field) is not None:
+                    recs.append(make_record(base, "scaling",
+                                            f"{key}.{field}", row[field],
+                                            unit, run_id=run_id))
+        return recs
+    # EXCHANGE*.json: {strategy -> {ms_per_exchange, ...}} or rows
+    if base.startswith("EXCHANGE"):
+        recs = []
+        rows = payload.get("rows")
+        items = (enumerate(rows) if isinstance(rows, list)
+                 else payload.items())
+        for key, row in items:
+            if not isinstance(row, dict):
+                continue
+            label = row.get("strategy", key)
+            for field in ("ms_per_exchange", "ms", "gbps"):
+                if row.get(field) is not None:
+                    unit = "ms" if "ms" in field else "gbps"
+                    recs.append(make_record(base, "exchange",
+                                            f"exchange.{label}.{field}",
+                                            row[field], unit,
+                                            run_id=run_id))
+        return recs
+    # SERVE.json: bench.py serve-mode report
+    if base.startswith("SERVE"):
+        recs = []
+        for field, unit in (("tokens_per_sec", "tokens/sec"),
+                            ("decode_tokens_per_sec", "tokens/sec"),
+                            ("ttft_p99_ms", "ms"), ("ttft_p50_ms", "ms"),
+                            ("token_p50_ms", "ms"), ("token_p99_ms", "ms")):
+            if payload.get(field) is not None:
+                recs.append(make_record(base, "serve", f"serve.{field}",
+                                        payload[field], unit,
+                                        run_id=run_id))
+        return recs
+    # ATTRIB.json: per-run attribution summary (telemetry/profile.py)
+    if "per_rank" in payload:
+        recs = []
+        rid = run_id or (f"pid{payload['pid']}" if "pid" in payload
+                         else None)
+        for rank, res in sorted(payload["per_rank"].items()):
+            mode = res.get("mode", "train")
+            wall = (res.get("wall_step") or {}).get("p50_ms")
+            if wall is not None:
+                recs.append(make_record(base, "attrib",
+                                        f"attrib.{mode}.step_ms", wall,
+                                        "ms", run_id=rid, rank=rank))
+            for seg, st in sorted((res.get("segments") or {}).items()):
+                if st.get("share") is not None:
+                    recs.append(make_record(
+                        base, "attrib", f"attrib.{mode}.{seg}_share",
+                        st["share"], "share", run_id=rid, rank=rank))
+        return recs
+    return []
+
+
+# -- reading -----------------------------------------------------------------
+
+def read_ledger(path: str) -> list[dict]:
+    """All well-formed records, append order.  A torn final line (the
+    crash contract of an append-only log) is skipped, as are foreign
+    lines — readers never fail on a half-written ledger."""
+    records: list[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and rec.get("schema") == 1:
+                    records.append(rec)
+    except OSError:
+        return []
+    return records
+
+
+def trajectories(records: list[dict]) -> dict[str, list[dict]]:
+    """metric -> append-ordered measurable points.  Stub runs
+    (``backend_unavailable``) carry no metric and drop out here — they
+    stay in the log as the gap's witness but never enter a baseline."""
+    out: dict[str, list[dict]] = {}
+    for rec in records:
+        if rec.get("kind") == "backend_unavailable":
+            continue
+        metric, value = rec.get("metric"), rec.get("value")
+        if metric is None or value is None:
+            continue
+        out.setdefault(metric, []).append(rec)
+    return out
+
+
+def _median(xs) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def check_records(records: list[dict],
+                  tolerance: float = DEFAULT_TOLERANCE,
+                  window: int = DEFAULT_WINDOW) -> list[dict]:
+    """Typed per-metric verdicts: latest vs trailing median.
+
+    ``regression`` — latest is worse than the median of the previous
+    ``window`` points by more than ``tolerance`` (relative);
+    ``improvement`` — better by more than ``tolerance``; ``ok`` —
+    within band; ``insufficient_history`` — fewer than 2 points.
+    """
+    verdicts = []
+    for metric, points in sorted(trajectories(records).items()):
+        latest = points[-1]
+        unit = latest.get("unit", "")
+        down = lower_is_better(metric, unit)
+        base = {"metric": metric, "unit": unit,
+                "direction": "lower_is_better" if down
+                else "higher_is_better",
+                "latest": latest["value"], "n_points": len(points),
+                "tolerance_pct": round(tolerance * 100, 2)}
+        if len(points) < 2:
+            verdicts.append({**base, "verdict": "insufficient_history",
+                             "baseline": None, "delta_pct": None})
+            continue
+        baseline = _median([p["value"] for p in points[:-1]][-window:])
+        delta = ((latest["value"] - baseline) / baseline if baseline
+                 else 0.0)
+        worse = delta > tolerance if down else delta < -tolerance
+        better = delta < -tolerance if down else delta > tolerance
+        verdict = ("regression" if worse
+                   else "improvement" if better else "ok")
+        verdicts.append({**base, "verdict": verdict,
+                         "baseline": round(baseline, 6),
+                         "delta_pct": round(delta * 100, 2)})
+    return verdicts
+
+
+def check_ledger(path: str, tolerance: float = DEFAULT_TOLERANCE,
+                 window: int = DEFAULT_WINDOW) -> list[dict]:
+    """Read + check in one lock-free call — the HealthMonitor's perf
+    detector uses this so no ledger lock nests inside the health lock."""
+    return check_records(read_ledger(path), tolerance, window)
+
+
+def regressions(verdicts: list[dict]) -> list[dict]:
+    return [v for v in verdicts if v["verdict"] == "regression"]
+
+
+# -- the writer --------------------------------------------------------------
+
+class PerfLedger:
+    """Append-only writer + snapshot publisher for one ledger file.
+
+    Thread-safe: bench.py's publish sites and a run's close path may
+    append concurrently.  Appends are line-granular (single ``write`` of
+    complete lines, flushed) so a crash tears at most the final line,
+    which :func:`read_ledger` skips.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def records(self) -> list[dict]:
+        with self._lock:
+            return read_ledger(self.path)
+
+    def append(self, records: list[dict], dedup: bool = True) -> list[dict]:
+        """Append normalized records; -> those actually written.
+
+        ``dedup`` skips records whose fingerprint is already in the log,
+        making artifact ingestion idempotent across re-runs.
+        """
+        if not records:
+            return []
+        with self._lock:
+            if dedup:
+                seen = {r.get("fp") for r in read_ledger(self.path)}
+                records = [r for r in records if r.get("fp") not in seen]
+            if not records:
+                return []
+            parent = os.path.dirname(self.path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            payload = "".join(json.dumps(r) + "\n" for r in records)
+            # heal a crash-torn tail: without the newline the first new
+            # record would concatenate onto the torn line and both lines
+            # would be unreadable forever
+            try:
+                with open(self.path, "rb") as f:
+                    f.seek(-1, os.SEEK_END)
+                    if f.read(1) != b"\n":
+                        payload = "\n" + payload
+            except OSError:  # lint: swallow-ok — no file yet / empty: nothing to heal
+                pass
+            # append-only journal: the log IS the artifact, rewriting it
+            # via tmp+replace would lose concurrent writers' lines — the
+            # torn-tail-skipping reader is the crash contract instead
+            with open(self.path, "a") as f:  # lint: atomic-publish-ok — append-only JSONL journal; readers skip a torn tail
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+        return records
+
+    def ingest_artifact(self, path: str) -> list[dict]:
+        """Classify + append one artifact file; -> records written."""
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            return []
+        return self.append(classify_artifact(path, payload))
+
+    def ingest(self, source: str, payload: dict) -> list[dict]:
+        """Classify + append an in-memory artifact (bench.py's publish
+        sites hand over the dict they just wrote)."""
+        return self.append(classify_artifact(source, payload))
+
+    def check(self, tolerance: float = DEFAULT_TOLERANCE,
+              window: int = DEFAULT_WINDOW) -> list[dict]:
+        return check_records(self.records(), tolerance, window)
+
+    def snapshot(self, path: str | None = None,
+                 tolerance: float = DEFAULT_TOLERANCE) -> str:
+        """Atomically publish the per-metric summary JSON (tmp +
+        ``os.replace`` — a reader never sees a torn file)."""
+        records = self.records()
+        verdicts = check_records(records, tolerance)
+        path = path or os.path.join(
+            os.path.dirname(self.path) or ".", SNAPSHOT_FILENAME)
+        payload = {
+            "updated": time.time(),  # lint: wall-ok — cross-process stamp
+            "ledger": os.path.basename(self.path),
+            "n_records": len(records),
+            "n_stub_runs": sum(1 for r in records
+                               if r.get("kind") == "backend_unavailable"),
+            "verdicts": verdicts,
+        }
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, path)
+        return path
+
+    def backfill(self, root: str) -> list[dict]:
+        """One-shot ingest of every known artifact under ``root`` (the
+        repo dir), in trajectory order.  Idempotent via fingerprints."""
+        written: list[dict] = []
+        for pattern in BACKFILL_PATTERNS:
+            for path in sorted(glob.glob(os.path.join(root, pattern))):
+                written.extend(self.ingest_artifact(path))
+        return written
+
+
+def bench_ledger_append(payload: dict, source: str,
+                        repo_dir: str | None = None) -> None:
+    """bench.py's one-liner: append one published artifact to the repo
+    ledger (``BENCH_LEDGER`` overrides the path; ``BENCH_LEDGER=0``
+    disables).  Never raises — a ledger hiccup must not cost the bench
+    its primary output line."""
+    dest = os.environ.get("BENCH_LEDGER")
+    if dest == "0":
+        return
+    if not dest:
+        dest = os.path.join(repo_dir or os.getcwd(), LEDGER_FILENAME)
+    try:
+        PerfLedger(dest).ingest(source, payload)
+    except Exception:  # lint: swallow-ok — advisory trajectory, bench line wins
+        pass

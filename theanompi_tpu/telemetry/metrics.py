@@ -168,6 +168,38 @@ EXCHANGE_SPANS = ("exchange.overlap",)
 EXCHANGE_GAUGES = ("exchange.ramp_phase",)
 EXCHANGE_INSTANTS = ("exchange.ramp_switch",)
 
+# -- step-attribution names (ISSUE 16) ----------------------------------------
+# The StepAttributor (``telemetry/profile.py``) publishes per-segment
+# per-step p50 milliseconds through these registered names ONLY at flush
+# boundaries (same one-source-of-truth contract as above, lint-enforced).
+# Train segments: data (prefetch dequeue + recorder wait), compute (fenced
+# step), comm (exchange overlap), validate / checkpoint (boundary spans),
+# host (unattributed remainder).  Serve segments: queue_wait / prefill /
+# decode / rollout_swap.  ``attr.step_ms`` is the wall p50 the segment
+# rows partition.
+ATTR_GAUGES = ("attr.data_ms", "attr.compute_ms", "attr.comm_ms",
+               "attr.validate_ms", "attr.checkpoint_ms", "attr.host_ms",
+               "attr.queue_wait_ms", "attr.prefill_ms", "attr.decode_ms",
+               "attr.rollout_swap_ms", "attr.step_ms")
+#: segment name -> registered gauge name (derived, one source of truth)
+ATTR_GAUGE_BY_SEGMENT = {
+    name[len("attr."):-len("_ms")]: name for name in ATTR_GAUGES
+}
+#: per-device HBM watermarks sampled at fenced flush boundaries (worst
+#: device wins the gauge; the per-device dict rides ATTRIB.json):
+#: peak = high-water ``peak_bytes_in_use``, live = last ``bytes_in_use``,
+#: limit = smallest ``bytes_limit``.  Absent entirely on CPU backends.
+PROF_GAUGES = ("prof.hbm_peak_bytes", "prof.hbm_live_bytes",
+               "prof.hbm_limit_bytes")
+#: ``prof.window``: the jax.profiler trace window opened/closed at the
+#: configured ``profile_window`` iterations (tags: phase = "start" |
+#: "stop", iteration) — the host-trace marker that aligns the device
+#: trace with the event stream.
+PROF_INSTANTS = ("prof.window",)
+#: ``ledger.regression``: the HealthMonitor's perf detector mirrored a
+#: regression verdict from PERF_LEDGER.jsonl (tags: metric, delta_pct).
+LEDGER_INSTANTS = ("ledger.regression",)
+
 
 class MetricsRegistry:
     """Named counters (monotonic totals), gauges (last value), histograms
@@ -252,15 +284,42 @@ def mfu(flops_per_step: float, step_time_s: float,
     return flops_per_step / step_time_s / peak
 
 
-def device_memory_stats() -> dict | None:
-    """HBM stats of local device 0 (None on backends without them — CPU)."""
+#: the memory_stats keys worth keeping (the rest are allocator internals)
+_MEMORY_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+def per_device_memory_stats() -> dict[int, dict]:
+    """HBM stats for EVERY local device: ``{device_index: {bytes_in_use,
+    peak_bytes_in_use, bytes_limit}}``.
+
+    None-safe throughout (ISSUE 16): devices whose ``memory_stats()`` is
+    missing, raises, or returns empty (the CPU backend) are skipped, so
+    CPU-only processes get ``{}`` rather than an exception — a straggling
+    device without stats never hides the ones that have them.
+    """
     try:
         import jax
 
-        stats = jax.local_devices()[0].memory_stats()
-    except Exception:  # lint: swallow-ok — backends without memory stats
-        return None
-    if not stats:
-        return None
-    keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
-    return {k: int(stats[k]) for k in keep if k in stats}
+        devices = jax.local_devices()
+    except Exception:  # lint: swallow-ok — no backend at all
+        return {}
+    out: dict[int, dict] = {}
+    for i, dev in enumerate(devices):
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # lint: swallow-ok — backends without memory stats
+            continue
+        if not stats:
+            continue
+        out[i] = {k: int(stats[k]) for k in _MEMORY_KEYS if k in stats}
+    return out
+
+
+def device_memory_stats() -> dict | None:
+    """HBM stats of local device 0 (None on backends without them — CPU).
+
+    Kept for existing callers; the per-device form above is the ISSUE 16
+    watermark source.
+    """
+    stats = per_device_memory_stats()
+    return stats.get(0) or None

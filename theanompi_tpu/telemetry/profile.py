@@ -1,0 +1,543 @@
+"""Step-time attribution profiler (ISSUE 16).
+
+ROADMAP item 3 says compute is now the ceiling (MFU 0.52 LM / 0.299
+resnet50) — but until now nothing in the repo could say *where* a step's
+milliseconds go.  :class:`StepAttributor` decomposes every step from the
+span/instant stream the trainer and serving scheduler already emit — no
+new instrumentation on the hot path, the existing events are re-read as
+a time budget:
+
+- **train mode** (any ``train.step`` span seen): ``data`` =
+  ``recorder.wait`` + ``prefetch.dequeue`` (the dequeue nests inside the
+  wait, so the union — not the sum — is charged), ``compute`` = the
+  fenced ``train.step`` / ``recorder.calc`` spans, ``comm`` =
+  ``exchange.overlap`` + ``recorder.comm``, ``validate`` /
+  ``checkpoint`` = the boundary spans between ``train.boundary``
+  instants, ``host`` = whatever remains of the wall window — the
+  unattributed dispatch/python gap.
+- **serve mode** (no train steps, ``serve.*`` spans seen): ``prefill`` /
+  ``decode`` from the scheduler's spans; unclaimed gaps containing a
+  ``serve.rollout*``/``serve.rollback`` instant become ``rollout_swap``,
+  every other gap is ``queue_wait``.
+
+Overlapping spans never double-charge: segments claim the timeline in a
+fixed precedence order (:data:`CLAIM_ORDER`) and each claim subtracts
+what earlier segments took, so the per-segment totals partition the wall
+window exactly — ``sum(segments) == window`` by construction, which is
+what lets the acceptance test demand the table sum to the measured wall
+time.
+
+Attribution is per rank and per thread: only spans on the step-emitting
+thread are charged (the async checkpoint writer's ``checkpoint.write``
+overlaps training and must not be billed as boundary stall; the blocking
+``checkpoint.snapshot`` is on the main thread and is).
+
+Publication: registered ``attr.*`` gauges at flush boundaries, an
+atomically-replaced ``ATTRIB.json`` (p50/p99 per segment, dominant-term
+verdict), and per-device HBM watermarks sampled at the same fenced
+boundaries (``prof.hbm_*`` — None-safe on CPU).  Off means off: a
+``Telemetry`` constructed without ``profile=`` makes zero calls here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+
+import numpy as np
+
+from theanompi_tpu.telemetry.metrics import (
+    ATTR_GAUGE_BY_SEGMENT,
+    PROF_GAUGES,
+    per_device_memory_stats,
+)
+
+ATTRIB_FILENAME = "ATTRIB.json"
+
+#: train-mode segments, in claim-precedence order (earlier claims win the
+#: overlap); ``host`` is the remainder and never claims
+TRAIN_SEGMENTS = ("comm", "checkpoint", "validate", "data", "compute",
+                  "host")
+#: serve-mode segments; ``queue_wait``/``rollout_swap`` split the remainder
+SERVE_SEGMENTS = ("prefill", "decode", "rollout_swap", "queue_wait")
+
+#: span name -> train segment (``checkpoint.*`` matches by prefix)
+_TRAIN_SPAN_SEGMENT = {
+    "recorder.wait": "data",
+    "prefetch.dequeue": "data",
+    "recorder.calc": "compute",
+    "train.step": "compute",
+    "recorder.comm": "comm",
+    "exchange.overlap": "comm",
+    "validate": "validate",
+}
+_SERVE_SPAN_SEGMENT = {"serve.prefill": "prefill", "serve.decode": "decode"}
+_ROLLOUT_INSTANTS = ("serve.rollout", "serve.rollout_refused",
+                     "serve.rollback")
+
+#: fold threshold: the streaming attributor buffers raw events and folds
+#: them into cumulative totals once the buffer crosses this, so a long
+#: run's memory stays bounded (~1.6k steps of train events per fold)
+_FOLD_EVENTS = 8192
+#: bounded per-segment per-step sample windows (percentile source)
+_SAMPLE_WINDOW = 2048
+
+
+# -- interval arithmetic -----------------------------------------------------
+# All segment math is on half-open [start, end) intervals in perf_counter
+# seconds.  merge/subtract keep lists sorted and disjoint, so measure()
+# is a plain sum and nothing double-counts.
+
+def _merge(intervals: list[tuple]) -> list[tuple]:
+    """Sorted union of possibly-overlapping intervals."""
+    out: list[tuple] = []
+    for a, b in sorted(intervals):
+        if b <= a:
+            continue
+        if out and a <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], b))
+        else:
+            out.append((a, b))
+    return out
+
+
+def _subtract(intervals: list[tuple], claimed: list[tuple]) -> list[tuple]:
+    """``intervals`` minus ``claimed`` (both sorted & disjoint)."""
+    out: list[tuple] = []
+    for a, b in intervals:
+        cur = a
+        for ca, cb in claimed:
+            if cb <= cur:
+                continue
+            if ca >= b:
+                break
+            if ca > cur:
+                out.append((cur, ca))
+            cur = max(cur, cb)
+            if cur >= b:
+                break
+        if cur < b:
+            out.append((cur, b))
+    return out
+
+
+def _measure(intervals: list[tuple]) -> float:
+    return sum(b - a for a, b in intervals)
+
+
+def _clip(intervals: list[tuple], lo: float, hi: float) -> list[tuple]:
+    return [(max(a, lo), min(b, hi)) for a, b in intervals
+            if b > lo and a < hi]
+
+
+# -- profile-window rule key -------------------------------------------------
+
+def parse_profile_window(value, default: tuple = (10, 20)) -> tuple:
+    """``profile_window`` rule key -> ``(start, stop)`` iteration ints.
+
+    Accepts a 2-sequence, or the string forms the launcher's ``--rule-set
+    profile_window=10:20`` hands over (``:``, ``-`` or ``,`` separated).
+    Without this, ``tuple("10:20")`` would silently become a 5-char tuple
+    and the trace window would never open.
+    """
+    if value is None:
+        return tuple(default)
+    if isinstance(value, str):
+        for sep in (":", "-", ","):
+            if sep in value:
+                parts = value.split(sep)
+                break
+        else:
+            raise ValueError(
+                f"profile_window={value!r}: expected START:STOP "
+                f"(e.g. 10:20)")
+        if len(parts) != 2:
+            raise ValueError(
+                f"profile_window={value!r}: expected exactly two "
+                f"iterations, got {len(parts)}")
+        return (int(parts[0]), int(parts[1]))
+    try:
+        start, stop = value
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"profile_window={value!r}: expected a (start, stop) pair")
+    start, stop = int(start), int(stop)
+    if stop < start:
+        raise ValueError(
+            f"profile_window={value!r}: stop precedes start")
+    return (start, stop)
+
+
+# -- offline attribution -----------------------------------------------------
+
+def _percentiles(samples: list[float]) -> dict:
+    if not samples:
+        return {}
+    arr = np.asarray(samples, dtype=float) * 1e3
+    return {"p50_ms": round(float(np.percentile(arr, 50)), 3),
+            "p99_ms": round(float(np.percentile(arr, 99)), 3),
+            "mean_ms": round(float(arr.mean()), 3)}
+
+
+def _rank_mode(spans: list[dict]) -> str:
+    names = {s.get("name") for s in spans}
+    if "train.step" in names:
+        return "train"
+    if names & set(_SERVE_SPAN_SEGMENT):
+        return "serve"
+    return "idle"
+
+
+def _segment_intervals(spans: list[dict], mode: str) -> dict:
+    table = _TRAIN_SPAN_SEGMENT if mode == "train" else _SERVE_SPAN_SEGMENT
+    per_seg: dict[str, list] = defaultdict(list)
+    for s in spans:
+        name = s.get("name", "")
+        seg = table.get(name)
+        if seg is None and mode == "train" and name.startswith("checkpoint."):
+            seg = "checkpoint"
+        if seg is None:
+            continue
+        t0 = float(s["ts"])
+        per_seg[seg].append((t0, t0 + float(s.get("dur", 0.0))))
+    return {seg: _merge(iv) for seg, iv in per_seg.items()}
+
+
+def attribute_rank_events(events: list[dict]) -> dict | None:
+    """Attribute one rank's events; None when no steps were seen.
+
+    The exact (non-streaming) form — ``tmprof <dir>`` and the streaming
+    fold both run through here, so the live gauges and the offline table
+    are the same numbers by construction.
+    """
+    spans = [e for e in events if e.get("kind") == "span"]
+    mode = _rank_mode(spans)
+    if mode == "idle":
+        return None
+    step_name = "train.step" if mode == "train" else "serve.decode"
+    step_spans = sorted((s for s in spans if s.get("name") == step_name),
+                        key=lambda s: float(s["ts"]))
+    if not step_spans:
+        return None
+    # charge only the step-emitting thread: the async checkpoint writer's
+    # checkpoint.write overlaps training and must not bill the boundary
+    tids = defaultdict(int)
+    for s in step_spans:
+        tids[s.get("tid")] += 1
+    main_tid = max(tids, key=tids.get)
+    spans = [s for s in spans if s.get("tid") == main_tid]
+
+    seg_iv = _segment_intervals(spans, mode)
+    t0 = min(float(s["ts"]) for s in spans)
+    t1 = max(float(s["ts"]) + float(s.get("dur", 0.0)) for s in spans)
+    window = [(t0, t1)]
+
+    order = (TRAIN_SEGMENTS[:-1] if mode == "train"
+             else ("prefill", "decode"))
+    claimed: list[tuple] = []
+    claims: dict[str, list] = {}
+    for seg in order:
+        iv = _subtract(_clip(seg_iv.get(seg, []), t0, t1), claimed)
+        claims[seg] = iv
+        claimed = _merge(claimed + iv)
+    remainder = _subtract(window, claimed)
+    if mode == "train":
+        claims["host"] = remainder
+    else:
+        # a gap holding a rollout/rollback instant is the hot-swap stall;
+        # every other gap is time the batch spent waiting for work
+        marks = sorted(float(e["ts"]) for e in events
+                       if e.get("kind") == "instant"
+                       and e.get("name") in _ROLLOUT_INSTANTS)
+        swap, wait = [], []
+        for a, b in remainder:
+            hit = any(a <= m < b for m in marks)
+            (swap if hit else wait).append((a, b))
+        claims["rollout_swap"] = swap
+        claims["queue_wait"] = wait
+
+    # per-step decomposition: consecutive windows between step-span ends
+    cuts = [t0] + [float(s["ts"]) + float(s.get("dur", 0.0))
+                   for s in step_spans]
+    per_step: dict[str, list] = {seg: [] for seg in claims}
+    walls: list[float] = []
+    for lo, hi in zip(cuts, cuts[1:]):
+        if hi <= lo:
+            continue
+        walls.append(hi - lo)
+        for seg, iv in claims.items():
+            per_step[seg].append(_measure(_clip(iv, lo, hi)))
+
+    segments = {}
+    window_s = t1 - t0
+    for seg, iv in claims.items():
+        total = _measure(iv)
+        segments[seg] = {
+            "total_s": round(total, 6),
+            "share": round(total / window_s, 4) if window_s else 0.0,
+            **_percentiles(per_step[seg]),
+        }
+    dominant = max(segments, key=lambda s: segments[s]["total_s"])
+    return {
+        "mode": mode,
+        "steps": len(step_spans),
+        "window_s": round(window_s, 6),
+        "wall_step": _percentiles(walls),
+        "segments": segments,
+        "dominant": {"segment": dominant,
+                     "share": segments[dominant]["share"],
+                     "verdict": f"{dominant}-bound"},
+    }
+
+
+def attribute_events(events: list[dict]) -> dict:
+    """Full-stream attribution -> the ``ATTRIB.json`` ``per_rank`` map."""
+    by_rank: dict[int, list] = defaultdict(list)
+    for e in events:
+        by_rank[int(e.get("rank", 0))].append(e)
+    out = {}
+    for rank in sorted(by_rank):
+        res = attribute_rank_events(
+            sorted(by_rank[rank], key=lambda e: float(e.get("ts", 0.0))))
+        if res is not None:
+            out[str(rank)] = res
+    return out
+
+
+# -- streaming attributor ----------------------------------------------------
+
+class StepAttributor:
+    """Feed it every emitted event (``observe``); it folds them into
+    bounded cumulative segment totals + per-step sample windows, serves
+    ``attr.*`` gauge values at flush boundaries, samples per-device HBM
+    watermarks, and publishes ``ATTRIB.json`` atomically.
+
+    Thread-safe: the train loop observes while the Telemetry health
+    ticker (or ``close()``) asks for gauges/writes.  Never takes another
+    lock while holding its own.
+    """
+
+    def __init__(self, directory: str, rank: int = 0):
+        self.directory = directory
+        self.rank = rank
+        self._lock = threading.Lock()
+        self._events: list[dict] = []
+        self._mode = "idle"
+        self._steps = 0
+        self._window_s = 0.0
+        self._totals: dict[str, float] = defaultdict(float)
+        self._samples: dict[str, list] = defaultdict(list)
+        self._walls: list[float] = []
+        self._hbm: dict[str, dict] = {}
+
+    # -- ingestion ----------------------------------------------------------
+    def observe(self, event: dict) -> None:
+        """O(1) append; a fold every ``_FOLD_EVENTS`` events keeps memory
+        bounded on long runs."""
+        kind = event.get("kind")
+        if kind not in ("span", "instant"):
+            return
+        with self._lock:
+            self._events.append(event)
+            if len(self._events) >= _FOLD_EVENTS:
+                self._fold()
+
+    def _fold(self) -> None:
+        """Attribute the buffered events up to the last complete step and
+        merge into the cumulative state.  Call with the lock held."""
+        res = attribute_rank_events(
+            sorted(self._events, key=lambda e: float(e.get("ts", 0.0))))
+        if res is None:
+            return
+        self._mode = res["mode"]
+        self._steps += res["steps"]
+        self._window_s += res["window_s"]
+        for seg, st in res["segments"].items():
+            self._totals[seg] += st["total_s"]
+        self._merge_samples(res)
+        # drop everything fully inside the folded window; spans still
+        # straddling the last step end stay for the next fold
+        step_name = "train.step" if res["mode"] == "train" else "serve.decode"
+        cut = max((float(e["ts"]) + float(e.get("dur", 0.0))
+                   for e in self._events
+                   if e.get("kind") == "span"
+                   and e.get("name") == step_name), default=None)
+        if cut is not None:
+            self._events = [
+                e for e in self._events
+                if float(e.get("ts", 0.0)) + float(e.get("dur", 0.0)) > cut]
+
+    def _merge_samples(self, res: dict) -> None:
+        walls = res.get("wall_step") or {}
+        if walls:
+            self._walls.append(walls.get("p50_ms", 0.0) / 1e3)
+        for seg, st in res["segments"].items():
+            if "p50_ms" in st:
+                self._samples[seg].append(st["p50_ms"] / 1e3)
+        for lst in (*self._samples.values(), self._walls):
+            if len(lst) > _SAMPLE_WINDOW:
+                del lst[: len(lst) - _SAMPLE_WINDOW]
+
+    # -- memory watermarks --------------------------------------------------
+    def sample_memory(self) -> dict[str, float]:
+        """Sample per-device HBM stats (None-safe — empty on CPU) into the
+        running watermarks; -> worst-device gauge values keyed by the
+        registered ``prof.hbm_*`` names."""
+        stats = per_device_memory_stats()
+        if not stats:
+            return {}
+        gauges: dict[str, float] = {}
+        with self._lock:
+            for dev, st in stats.items():
+                w = self._hbm.setdefault(str(dev), {})
+                live = st.get("bytes_in_use")
+                if live is not None:
+                    w["bytes_in_use"] = int(live)
+                    w["peak_bytes_in_use"] = max(
+                        int(st.get("peak_bytes_in_use", live)),
+                        w.get("peak_bytes_in_use", 0))
+                if "bytes_limit" in st:
+                    w["bytes_limit"] = int(st["bytes_limit"])
+            peaks = [w.get("peak_bytes_in_use", 0)
+                     for w in self._hbm.values()]
+            lives = [w.get("bytes_in_use", 0) for w in self._hbm.values()]
+            limits = [w["bytes_limit"] for w in self._hbm.values()
+                      if "bytes_limit" in w]
+        if peaks:
+            gauges[PROF_GAUGES[0]] = float(max(peaks))
+        if lives:
+            gauges[PROF_GAUGES[1]] = float(max(lives))
+        if limits:
+            gauges[PROF_GAUGES[2]] = float(min(limits))
+        return gauges
+
+    # -- readout ------------------------------------------------------------
+    def _result_locked(self) -> dict | None:
+        """Cumulative + still-buffered view.  Call with the lock held."""
+        live = attribute_rank_events(
+            sorted(self._events, key=lambda e: float(e.get("ts", 0.0))))
+        if live is None and self._steps == 0:
+            return None
+        if self._steps == 0:
+            return live
+        if live is None:
+            live = {"mode": self._mode, "steps": 0, "window_s": 0.0,
+                    "segments": {}, "wall_step": {}}
+        segs = set(self._totals) | set(live["segments"])
+        segments = {}
+        window_s = self._window_s + live["window_s"]
+        for seg in segs:
+            total = self._totals.get(seg, 0.0) + live["segments"].get(
+                seg, {}).get("total_s", 0.0)
+            samples = list(self._samples.get(seg, ()))
+            live_p50 = live["segments"].get(seg, {}).get("p50_ms")
+            if live_p50 is not None:
+                samples.append(live_p50 / 1e3)
+            segments[seg] = {
+                "total_s": round(total, 6),
+                "share": round(total / window_s, 4) if window_s else 0.0,
+                **_percentiles(samples),
+            }
+        dominant = max(segments, key=lambda s: segments[s]["total_s"])
+        walls = list(self._walls)
+        if live.get("wall_step", {}).get("p50_ms") is not None:
+            walls.append(live["wall_step"]["p50_ms"] / 1e3)
+        return {
+            "mode": live["mode"],
+            "steps": self._steps + live["steps"],
+            "window_s": round(window_s, 6),
+            "wall_step": _percentiles(walls),
+            "segments": segments,
+            "dominant": {"segment": dominant,
+                         "share": segments[dominant]["share"],
+                         "verdict": f"{dominant}-bound"},
+        }
+
+    def gauges(self) -> dict[str, float]:
+        """Registered ``attr.*`` gauge values (per-step p50 ms per
+        segment) for the flush boundary; empty before the first step."""
+        with self._lock:
+            res = self._result_locked()
+        if res is None:
+            return {}
+        out: dict[str, float] = {}
+        for seg, st in res["segments"].items():
+            name = ATTR_GAUGE_BY_SEGMENT.get(seg)
+            if name is not None and "p50_ms" in st:
+                out[name] = st["p50_ms"]
+        p50 = res.get("wall_step", {}).get("p50_ms")
+        if p50 is not None:
+            out[ATTR_GAUGE_BY_SEGMENT["step"]] = p50
+        return out
+
+    def result(self) -> dict | None:
+        with self._lock:
+            return self._result_locked()
+
+    def write(self, path: str | None = None) -> str | None:
+        """Atomically publish ``ATTRIB.json`` (tmp + ``os.replace`` — a
+        reader never sees a torn file); None when no steps ran."""
+        with self._lock:
+            res = self._result_locked()
+            hbm = {d: dict(w) for d, w in self._hbm.items()}
+        if res is None:
+            return None
+        path = path or os.path.join(self.directory, ATTRIB_FILENAME)
+        payload = {
+            # wall stamp: the perf ledger correlates runs across processes
+            "updated": time.time(),  # lint: wall-ok — cross-process stamp
+            "pid": os.getpid(),
+            "rank": self.rank,
+            "per_rank": {str(self.rank): res},
+        }
+        if hbm:
+            payload["hbm"] = hbm
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, path)
+        return path
+
+
+def read_attrib(directory: str) -> dict | None:
+    """Parse ``<directory>/ATTRIB.json``; None when absent/unreadable."""
+    path = os.path.join(directory, ATTRIB_FILENAME)
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) else None
+
+
+def format_attribution(per_rank: dict) -> str:
+    """The ``tmprof`` attribution table: one block per rank, one line per
+    segment, shares + per-step percentiles, dominant-term verdict."""
+    lines = []
+    for rank, res in sorted(per_rank.items()):
+        lines.append(f"rank {rank}  [{res['mode']}]  steps {res['steps']}  "
+                     f"window {res['window_s']:.3f}s")
+        wall = res.get("wall_step") or {}
+        if wall:
+            lines.append(f"  step wall: p50 {wall.get('p50_ms', 0):.1f}ms  "
+                         f"p99 {wall.get('p99_ms', 0):.1f}ms")
+        order = TRAIN_SEGMENTS if res["mode"] == "train" else SERVE_SEGMENTS
+        total = 0.0
+        for seg in order:
+            st = res["segments"].get(seg)
+            if st is None:
+                continue
+            total += st["total_s"]
+            pct = ("" if "p50_ms" not in st else
+                   f"  p50 {st['p50_ms']:8.1f}ms  p99 {st['p99_ms']:8.1f}ms")
+            lines.append(f"  {seg:<12} {st['total_s']:9.3f}s "
+                         f"{st['share']:7.1%}{pct}")
+        lines.append(f"  {'sum':<12} {total:9.3f}s "
+                     f"{total / res['window_s'] if res['window_s'] else 0:7.1%}")
+        dom = res["dominant"]
+        lines.append(f"  verdict: {dom['verdict']} "
+                     f"({dom['segment']} {dom['share']:.1%} of window)")
+    return "\n".join(lines)
