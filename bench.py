@@ -332,7 +332,11 @@ def run_serve_bench() -> dict:
     Knobs (all optional): BENCH_SERVE_REQUESTS / _PROMPT / _NEW / _BATCH /
     _BLOCK_SIZE / _BLOCKS / _RATE (req/s, 0 = burst) / _QUANT (int8
     weights) / _CKPT (verified checkpoint dir) / _SET (semicolon-separated
-    model k=v pairs layered over the bench transformer geometry).
+    model k=v pairs layered over the bench transformer geometry) /
+    _PREFIX_CACHE (radix prefix cache, ISSUE 17) / _TURNS (multi-turn
+    sessions of this many requests each) / _SHARED_PREFIX (identical
+    system-prompt tokens on every request) — the last three surface in
+    SERVE.json as prefix_hit_rate / prefill_tokens_saved.
     """
     from theanompi_tpu.serving import cli as serve_cli
 
@@ -365,10 +369,13 @@ def run_serve_bench() -> dict:
                     if env("BENCH_SERVE_BLOCKS") else None),
         quantize_int8=bool(int(env("BENCH_SERVE_QUANT", "0"))),
         top_k=0,
+        prefix_cache=bool(int(env("BENCH_SERVE_PREFIX_CACHE", "0"))),
         requests=int(env("BENCH_SERVE_REQUESTS", "16")),
         prompt_len=int(env("BENCH_SERVE_PROMPT", "16")),
         max_new_tokens=int(env("BENCH_SERVE_NEW", "32")),
         arrival_rate=float(env("BENCH_SERVE_RATE", "0")),
+        turns=int(env("BENCH_SERVE_TURNS", "1")),
+        shared_prefix_len=int(env("BENCH_SERVE_SHARED_PREFIX", "0")),
         temperature=0.0, seed=int(env("BENCH_SEED", "0")),
         telemetry_dir=env("BENCH_TELEMETRY_DIR") or None,
         out=None, quiet=True,

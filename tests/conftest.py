@@ -27,6 +27,27 @@ def subproc_compile_cache(tmp_path_factory):
     return str(tmp_path_factory.mktemp("subproc-ccache"))
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _session_compile_cache_env(subproc_compile_cache):
+    """Tier-1 velocity (ISSUE 17 satellite): export the session compile
+    cache as ``THEANOMPI_COMPILE_CACHE`` so every ``python -m
+    theanompi_tpu.launcher`` subprocess — including the ones that never
+    passed ``--compile-cache-dir`` — shares the one warm XLA cache (the
+    launcher's ``__main__`` block injects the flag from the env).
+    In-process ``launcher.main([...])`` calls are untouched: every
+    production ``setup_compile_cache`` call site passes an explicit
+    directory, so the env fallback never fires inside the test process."""
+    import os
+
+    prev = os.environ.get("THEANOMPI_COMPILE_CACHE")
+    os.environ["THEANOMPI_COMPILE_CACHE"] = subproc_compile_cache
+    yield
+    if prev is None:
+        os.environ.pop("THEANOMPI_COMPILE_CACHE", None)
+    else:
+        os.environ["THEANOMPI_COMPILE_CACHE"] = prev
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     from theanompi_tpu.parallel.mesh import make_mesh

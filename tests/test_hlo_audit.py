@@ -116,6 +116,16 @@ def test_serve_decode_audit():
     assert r["host_callbacks"] == []
 
 
+def test_serve_prefill_audit():
+    """ISSUE 17: the prefix-cache hit path (partial prefill) holds the
+    same HLO contract as decode — donated pools, zero collectives."""
+    r = hlo_audit.audit_serve_prefill()
+    assert r["ok"], r["violations"]
+    assert r["alias_count"] >= 2          # k and v pools donated
+    assert r["collectives"] == {}
+    assert r["host_callbacks"] == []
+
+
 @pytest.mark.parametrize("strategy", hlo_audit.DEFAULT_OVERLAP_STRATEGIES)
 def test_overlap_schedule_audit(strategy):
     """ISSUE 12 acceptance: the optimized HLO proves the overlapped
@@ -136,7 +146,7 @@ def test_run_default_audits_is_green():
     assert [(r["kind"], r.get("strategy")) for r in reports] == [
         ("train", "psum_bucket"), ("train", "zero1"),
         ("train-overlap", "psum_bucket"), ("train-overlap", "zero1"),
-        ("serve", None)]
+        ("serve", None), ("serve-prefill", None)]
     assert all(r["ok"] for r in reports)
 
 
@@ -187,7 +197,7 @@ def test_budget_violation_surfaces_in_report(monkeypatch):
     # the tightened psum_bucket TRAIN lock fails — the overlap audits
     # have their own invariants and stay green
     assert [rep["ok"] for rep in ei.value.reports] == [
-        False, True, True, True, True]
+        False, True, True, True, True, True]
 
 
 def test_train_cfg_matches_the_locked_fixture():

@@ -101,6 +101,37 @@ def test_classify_scaling_and_attrib():
     assert by_metric["attrib.train.step_ms"]["run_id"] == "pid7"
 
 
+def test_classify_serve_report():
+    """SERVE.json carries top-level metric/value like a bare bench line —
+    the SERVE branch must win (basename precedence) so the nested latency
+    percentiles and the ISSUE 17 prefix-cache accounting are kept."""
+    serve = {"metric": "serve_tokens_per_sec", "value": 812.5,
+             "unit": "tokens/sec", "run_id": "r9",
+             "ttft_ms": {"p50": 11.0, "p99": 30.5},
+             "token_ms": {"p50": 2.0, "p99": 4.5},
+             "prefix_cache": True, "prefix_hit_rate": 0.72,
+             "prefill_tokens_saved": 4096}
+    by_metric = {r["metric"]: r for r in
+                 classify_artifact("SERVE.json", serve)}
+    assert by_metric["serve.tokens_per_sec"]["value"] == 812.5
+    assert by_metric["serve.tokens_per_sec"]["kind"] == "serve"
+    assert by_metric["serve.ttft_p99_ms"]["value"] == 30.5
+    assert by_metric["serve.ttft_p99_ms"]["unit"] == "ms"
+    assert by_metric["serve.token_p50_ms"]["value"] == 2.0
+    assert by_metric["serve.prefix_hit_rate"]["value"] == 0.72
+    assert by_metric["serve.prefill_tokens_saved"]["value"] == 4096
+    assert all(r["run_id"] == "r9" for r in by_metric.values())
+    # cache-off runs keep the prefix metrics OUT of the trajectory (their
+    # zeros would poison the baseline median)
+    off = {r["metric"] for r in classify_artifact(
+        "SERVE.json", {**serve, "prefix_cache": False})}
+    assert not any("prefix" in m for m in off)
+    assert "serve.tokens_per_sec" in off
+    # direction inference: hit rate and tokens saved improve upward
+    assert not lower_is_better("serve.prefix_hit_rate", "rate")
+    assert not lower_is_better("serve.prefill_tokens_saved", "tokens")
+
+
 def test_classify_unknown_shape_yields_nothing():
     assert classify_artifact("WHAT.json", {"stuff": 1}) == []
     assert classify_artifact("X.json", ["not", "a", "dict"]) == []

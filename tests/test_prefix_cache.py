@@ -1,0 +1,390 @@
+"""ISSUE 17: prefix-cached paged KV — refcounted copy-on-write shared
+blocks, radix prompt matching, partial prefill.
+
+The contract under test, end to end on the CPU mesh:
+
+- **refcounts** — BlockPool alloc/acquire/free keep shared blocks live
+  until the LAST holder releases them; double frees and out-of-pool ids
+  raise instead of corrupting the free list (O(1) free-set membership);
+- **radix tree** — full-block token chunks chained on the parent map
+  prompt prefixes to block ids; insert dedups, match acquires, LRU
+  eviction only takes zero-ref leaves, and the params-version stamp
+  drops the whole tree on a weight swap;
+- **partial prefill** — a prefix-cache hit computes K/V and logits for
+  the SUFFIX only, bucketed power-of-two on suffix length, and
+  reproduces the full-prefill next token + last-position logits;
+- **bit-equality (the acceptance lock)** — 12+ multi-turn shared-prefix
+  requests through a pool tight enough to force eviction, greedy AND
+  temperature sampling: cache-ON token streams identical to cache-OFF,
+  with prefix_hit_rate > 0 and prefill_tokens_saved exactly the sum of
+  matched-prefix lengths the engine was handed;
+- **invalidation-on-rollout** — the NEGATIVE test: with the stamp
+  defeated, a weight swap serves stale cached K/V and the streams
+  diverge from a cold-cache run under the new weights; with the stamp
+  honored they are bit-equal;
+- **eviction under sharing** — preempting one of two prefix-sharing
+  requests mid-decode leaves the survivor's blocks live, the preempted
+  request's recompute-prefill hits the cache, and both streams stay
+  bit-equal to a cold run.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from theanompi_tpu.serving import (
+    BlockPool,
+    InferenceEngine,
+    PrefixCache,
+    Request,
+    Scheduler,
+    blocks_for,
+    run_open_loop,
+    serve_report,
+)
+from theanompi_tpu.serving.cli import synthetic_requests
+
+VOCAB = 61  # SERVING_TINY's vocab (the dense_model fixture)
+
+
+# -- BlockPool refcounts ------------------------------------------------------
+
+def test_block_pool_refcount_lifecycle():
+    pool = BlockPool(6)  # block 0 reserved -> 5 usable
+    row = pool.alloc(2)
+    assert all(pool.ref(b) == 1 for b in row)
+    pool.acquire(row)  # a second holder
+    assert all(pool.ref(b) == 2 for b in row)
+    free_before = pool.free_blocks
+    pool.free(row)  # first holder leaves: blocks stay live
+    assert all(pool.ref(b) == 1 for b in row)
+    assert pool.free_blocks == free_before
+    pool.free(row)  # last holder leaves: blocks return to the free list
+    assert all(pool.ref(b) == 0 for b in row)
+    assert pool.free_blocks == free_before + 2
+    # freed blocks are allocatable again
+    again = pool.alloc(5)
+    assert again is not None and set(row) <= set(again)
+
+
+def test_block_pool_double_free_and_range_checks():
+    pool = BlockPool(6)
+    row = pool.alloc(2)
+    pool.free(row)
+    with pytest.raises(ValueError, match="double free"):
+        pool.free([row[0]])
+    with pytest.raises(ValueError, match="double free"):
+        # duplicate within ONE call: the free-set catches it mid-batch
+        two = pool.alloc(1)
+        pool.free([two[0], two[0]])
+    with pytest.raises(ValueError, match="outside pool"):
+        pool.free([0])  # the reserved null block is never pool-managed
+    with pytest.raises(ValueError, match="outside pool"):
+        pool.free([6])
+    with pytest.raises(ValueError, match="outside pool"):
+        pool.acquire([99])
+    with pytest.raises(ValueError, match="acquiring free block"):
+        pool.acquire([row[1]])  # unallocated: nothing to share
+
+
+# -- PrefixCache radix tree ---------------------------------------------------
+
+def test_prefix_cache_match_insert_dedup():
+    pool = BlockPool(16)
+    cache = PrefixCache(pool, 4)
+    row = pool.alloc(2)
+    assert cache.insert([1, 2, 3, 4, 5, 6, 7, 8], row) == 2
+    assert cache.n_nodes == 2
+    # match acquires IN SEQUENCE ORDER and caps below the full prompt:
+    # at least one token must stay uncached for next-token logits
+    assert cache.match([1, 2, 3, 4, 5, 6, 7, 8, 9]) == row
+    assert all(pool.ref(b) == 2 for b in row)  # tree + the match
+    assert cache.match([1, 2, 3, 4, 5, 6, 7, 8]) == row[:1]
+    assert cache.match([1, 2, 3, 4]) == []
+    assert cache.match([9, 9, 9, 9, 9]) == []  # divergent first chunk
+    # divergence mid-prefix stops the walk at the last matching block
+    assert cache.match([1, 2, 3, 4, 9, 9, 9, 9, 9]) == row[:1]
+    pool.free(row + row[:2])  # release every matched ref
+    # dedup: inserting an already-cached chunk releases the caller's copy
+    dup = pool.alloc(2)
+    free_before = pool.free_blocks
+    assert cache.insert([1, 2, 3, 4, 5, 6, 7, 8], dup) == 0
+    assert cache.n_nodes == 2
+    assert pool.free_blocks == free_before + 2  # both dup refs released
+    with pytest.raises(ValueError, match="full"):
+        cache.insert([1, 2, 3], pool.alloc(1))
+
+
+def test_prefix_cache_lru_eviction_spares_shared_blocks():
+    pool = BlockPool(16)
+    cache = PrefixCache(pool, 2)
+    a = pool.alloc(2)
+    b = pool.alloc(1)
+    cache.insert([1, 2, 3, 4], a)     # chain a: two blocks
+    cache.insert([9, 9], b)           # chain b: one block
+    # touch chain a -> chain b is now LRU
+    held = cache.match([1, 2, 3, 4, 5])
+    assert held == a
+    # chain a's blocks are shared (ref 2): only b is evictable
+    assert cache.evict(3) == 1
+    assert cache.n_nodes == 2 and pool.ref(b[0]) == 0
+    pool.free(held)  # the match's refs released: tree is sole holder
+    # leaves evict deepest-first: a[1] (leaf) then a[0] (exposed parent)
+    assert cache.evict(2) == 2
+    assert cache.n_nodes == 0
+    assert pool.free_blocks == 15
+
+
+def test_prefix_cache_version_stamp():
+    pool = BlockPool(8)
+    cache = PrefixCache(pool, 2)
+    # first stamp: adopts the version, nothing to invalidate
+    assert cache.check_version(0) is False
+    row = pool.alloc(2)
+    cache.insert([1, 2, 3, 4], row)
+    assert cache.check_version(0) is False  # same version: no-op
+    assert cache.n_nodes == 2
+    free_before = pool.free_blocks
+    assert cache.check_version(1) is True  # weight swap: whole tree drops
+    assert cache.n_nodes == 0
+    assert pool.free_blocks == free_before + 2
+    assert cache.params_version == 1
+    assert cache.check_version(1) is False
+
+
+# -- partial prefill (engine level) -------------------------------------------
+
+def test_partial_prefill_matches_full_prefill(dense_model):
+    """A prefix-cache hit reuses the cached blocks' K/V and computes the
+    suffix only — same next token, same last-position logits (within
+    float round-off of the paged-gather attention path)."""
+    model, params, _state = dense_model
+    engine = InferenceEngine(model, params, block_size=4, max_batch=2,
+                             seed=0)
+    pool = BlockPool(engine.num_blocks)
+    rng = np.random.RandomState(5)
+    prompt = [int(x) for x in rng.randint(0, VOCAB, 10)]
+
+    row_a = pool.alloc(blocks_for(len(prompt), 4))
+    tok_a, last_a = engine.prefill(row_a, prompt, 0.0, rid=1)
+    # partial: the first two blocks' K/V is already in the pool (row_a
+    # wrote it) — share them, compute only tokens 8..9
+    row_b = row_a[:2] + pool.alloc(1)
+    tok_b, last_b = engine.prefill(row_b, prompt, 0.0, rid=1, prefix_len=8)
+    assert tok_a == tok_b
+    np.testing.assert_allclose(last_b, last_a, rtol=1e-4, atol=1e-4)
+    # temperature path: the sample key derives from (rid, position) only,
+    # so the partial-prefill sample reproduces the full-prefill sample
+    row_c = pool.alloc(blocks_for(len(prompt), 4))
+    tok_c, _ = engine.prefill(row_c, prompt, 0.9, rid=7)
+    row_d = row_c[:1] + pool.alloc(2)
+    tok_d, _ = engine.prefill(row_d, prompt, 0.9, rid=7, prefix_len=4)
+    assert tok_c == tok_d
+
+    with pytest.raises(ValueError, match="whole number"):
+        engine.prefill(row_a, prompt, 0.0, rid=1, prefix_len=3)
+    with pytest.raises(ValueError, match="at least one token"):
+        engine.prefill(row_a, prompt, 0.0, rid=1, prefix_len=12)
+
+
+def test_partial_prefill_program_count_is_log_bounded(dense_model):
+    """Suffix programs bucket power-of-two on the PADDED SUFFIX length
+    (the full row is fixed-width), so a serve accumulates at most
+    log2(max_blocks_per_seq)+1 partial-prefill programs — compile cost
+    stays bounded no matter the prefix/suffix mix."""
+    model, params, _state = dense_model
+    engine = InferenceEngine(model, params, block_size=4, max_batch=2,
+                             seed=0)
+    pool = BlockPool(engine.num_blocks)
+    rng = np.random.RandomState(6)
+    bound = int(np.log2(engine.max_blocks_per_seq)) + 1
+    for total, prefix_len in ((6, 4), (10, 4), (12, 8), (16, 4), (20, 8),
+                              (24, 20), (30, 8)):
+        prompt = [int(x) for x in rng.randint(0, VOCAB, total)]
+        row = pool.alloc(blocks_for(total, 4))
+        engine.prefill(row, prompt, 0.0, rid=1, prefix_len=prefix_len)
+        pool.free(row)
+    assert len(engine._prefill_suffix_fns) <= bound
+    # and the buckets are exactly power-of-two block multiples
+    assert all(s % 4 == 0 and (s // 4) & (s // 4 - 1) == 0
+               for s in engine._prefill_suffix_fns)
+
+
+# -- the acceptance lock ------------------------------------------------------
+
+def _serve_traffic(model, params, *, prefix_cache, num_blocks, spy=None):
+    """12 multi-turn shared-prefix requests, greedy/temperature mixed, one
+    scheduler; -> ({rid: token tuple}, scheduler, report)."""
+    engine = InferenceEngine(model, params, block_size=4, max_batch=4,
+                             num_blocks=num_blocks, seed=0)
+    if spy is not None:
+        orig = engine.prefill
+
+        def record(table_row, tokens, temperature=0.0, rid=0, prefix_len=0):
+            spy.append(prefix_len)
+            return orig(table_row, tokens, temperature, rid,
+                        prefix_len=prefix_len)
+
+        engine.prefill = record
+    sched = Scheduler(engine, prefix_cache=prefix_cache)
+    reqs = synthetic_requests(12, VOCAB, 4, 8, 0.0, 0, temperature=0.0,
+                              turns=3, shared_prefix=8)
+    for r in reqs:
+        if r.rid % 2:
+            r.temperature = 0.8
+    results, wall = run_open_loop(sched, reqs)
+    rep = serve_report(results, wall, sched)
+    return {r.rid: tuple(r.generated) for r in results.values()}, sched, rep
+
+
+def test_prefix_cache_on_off_bit_equal_under_eviction(dense_model):
+    """THE acceptance lock: cache-ON greedy AND temperature token streams
+    are bit-equal to cache-OFF across 12 multi-turn shared-prefix
+    requests through a pool tight enough to force preemption, with
+    prefix_hit_rate > 0 and prefill_tokens_saved EXACTLY the sum of the
+    matched-prefix lengths handed to engine.prefill."""
+    model, params, _state = dense_model
+    off, sched_off, rep_off = _serve_traffic(
+        model, params, prefix_cache=False, num_blocks=20)
+    seen = []
+    on, sched_on, rep_on = _serve_traffic(
+        model, params, prefix_cache=True, num_blocks=20, spy=seen)
+
+    assert all(len(t) == 8 for t in off.values())
+    assert on == off, {k: (off[k], on[k]) for k in off if off[k] != on[k]}
+    # the pool was sized to force eviction WITH the tree holding blocks
+    assert sched_on.n_preemptions > 0
+    # accounting is exact, not sampled: every prefill's prefix_len summed
+    assert rep_on["prefix_cache"] is True
+    assert rep_on["prefix_hit_rate"] > 0
+    assert rep_on["prefill_tokens_saved"] == sum(seen) > 0
+    assert sched_on.n_prefix_hits == sum(1 for s in seen if s)
+    assert rep_off["prefix_cache"] is False
+    assert rep_off["prefix_hit_rate"] == 0.0
+    assert rep_off["prefill_tokens_saved"] == 0
+    # nothing leaked: finished requests released their refs, only the
+    # radix tree still pins blocks
+    assert sched_on.pool.free_blocks + sched_on.prefix_cache.n_nodes == 19
+
+
+def test_swap_params_invalidates_prefix_cache(dense_model):
+    """The rollout-invalidation contract, proven in BOTH directions.
+
+    Negative half (the bug the stamp prevents): defeat the stamp by
+    hand-setting the cache's params_version after a weight swap — cached
+    K/V computed under the OLD weights then serves the new requests, and
+    their token streams DIVERGE from a cold-cache run under the new
+    weights.  Positive half: with the stamp honored, the swap drops the
+    whole tree and the streams are bit-equal to the cold run."""
+    model, params, _state = dense_model
+    params2, _ = model.init_params(jax.random.PRNGKey(123))
+
+    def batch():
+        rng = np.random.RandomState(11)
+        shared = [int(x) for x in rng.randint(0, VOCAB, 12)]
+        return [Request(rid=i,
+                        prompt=shared + [int(x) for x in
+                                         rng.randint(0, VOCAB, 2)],
+                        max_new_tokens=6)
+                for i in range(4)]
+
+    def mk(tree):
+        engine = InferenceEngine(model, tree, block_size=4, max_batch=2,
+                                 num_blocks=40, seed=0)
+        return engine, Scheduler(engine, prefix_cache=True)
+
+    def streams(results):
+        return {r.rid: tuple(r.generated) for r in results.values()}
+
+    # cold-cache reference under the NEW weights
+    _eng_ref, sched_ref = mk(params2)
+    ref = streams(run_open_loop(sched_ref, batch())[0])
+
+    # negative: warm the tree under the old weights, swap, TAMPER the
+    # stamp so the invalidation check can't fire, serve again
+    eng, sched = mk(params)
+    run_open_loop(sched, batch())
+    assert sched.prefix_cache.n_nodes > 0
+    eng.swap_params(params2)
+    sched.prefix_cache.params_version = eng.params_version  # defeat stamp
+    hits_before = sched.n_prefix_hits
+    stale = streams(run_open_loop(sched, batch())[0])
+    assert sched.n_prefix_hits > hits_before  # stale K/V WAS served
+    assert stale != ref, (
+        "stale cached K/V across a weight swap produced the new-weight "
+        "streams — the negative test lost its teeth")
+
+    # positive: same flow with the stamp honored — the tree drops at the
+    # first admission after the swap and the streams match the cold run
+    eng3, sched3 = mk(params)
+    run_open_loop(sched3, batch())
+    assert sched3.prefix_cache.n_nodes > 0
+    eng3.swap_params(params2)
+    ok = streams(run_open_loop(sched3, batch())[0])
+    assert ok == ref
+    assert sched3.prefix_cache.params_version == eng3.params_version
+    # restore_params is a THIRD weight state: the stamp moves again
+    v = eng3.params_version
+    eng3.restore_params(eng3.params)
+    assert eng3.params_version == v + 1
+
+
+def test_eviction_under_sharing_keeps_survivor_blocks_live(dense_model):
+    """Preempt one of two prefix-SHARING requests mid-decode: refcounts
+    keep the shared blocks live for the survivor, the preempted request's
+    recompute-prefill hits the cache, and both token streams stay
+    bit-equal to a cold-cache (cache-OFF, roomy-pool) run."""
+    model, params, _state = dense_model
+    rng = np.random.RandomState(4)
+    shared = [int(x) for x in rng.randint(0, VOCAB, 12)]
+    sfx_b, sfx_c = ([int(x) for x in rng.randint(0, VOCAB, 2)]
+                    for _ in range(2))
+
+    def reqs():
+        return [Request(rid=1, prompt=shared + sfx_b, max_new_tokens=8),
+                Request(rid=2, prompt=shared + sfx_c, max_new_tokens=8,
+                        temperature=0.8)]
+
+    # cold reference: no cache, no pressure, no preemption
+    eng_ref = InferenceEngine(model, params, block_size=4, max_batch=2,
+                              num_blocks=24, seed=0)
+    ref_res, _ = run_open_loop(Scheduler(eng_ref), reqs())
+    ref = {r.rid: tuple(r.generated) for r in ref_res.values()}
+
+    engine = InferenceEngine(model, params, block_size=4, max_batch=2,
+                             num_blocks=24, seed=0)
+    sched = Scheduler(engine, prefix_cache=True)
+    # warm the tree: one completed request whose prompt IS the shared
+    # prefix (its 3 full blocks land in the radix tree)
+    run_open_loop(sched, [Request(rid=0, prompt=list(shared),
+                                  max_new_tokens=4)])
+    finished = []
+    for r in reqs():
+        sched.submit(r)
+    finished += sched.step()
+    # both admissions matched the tree's 3 shared blocks
+    assert sched.n_prefix_hits >= 2
+    slot_b = next(s for s, r in enumerate(sched.slots)
+                  if r is not None and r.rid == 1)
+    slot_c = next(s for s, r in enumerate(sched.slots)
+                  if r is not None and r.rid == 2)
+    shared_ids = sched._blocks[slot_b][:3]
+    assert sched._blocks[slot_c][:3] == shared_ids  # genuinely shared
+    assert all(sched.pool.ref(b) == 3 for b in shared_ids)  # tree + b + c
+
+    finished += sched.step()
+    victim = sched.slots[slot_b]
+    sched._preempt(slot_b)  # forced mid-decode eviction of ONE sharer
+    # the survivor (and the tree) still hold the shared blocks
+    assert all(sched.pool.ref(b) == 2 for b in shared_ids)
+    assert sched.slots[slot_c] is not None
+    hits_before = sched.n_prefix_hits
+    while not sched.idle:
+        finished += sched.step()
+    assert victim.n_preemptions == 1
+    # the recompute-prefill re-matched the cache instead of recomputing
+    # the shared prefix from scratch
+    assert sched.n_prefix_hits > hits_before
+    got = {r.rid: tuple(r.generated) for r in finished if r.rid in (1, 2)}
+    assert got == ref

@@ -256,6 +256,49 @@ def test_runbook_serve_command(tmp_path, capsys):
     assert "serve.prefill" in names and "serve.decode" in names
 
 
+def test_runbook_serve_prefix_cache_command(tmp_path):
+    """BASELINE step 6c (ISSUE 17): the exact multi-turn prefix-cache
+    rehearsal invocation — --prefix-cache with --turns/--shared-prefix-len
+    traffic — and the SERVE.json accounting fields the step reads
+    (prefix_cache, prefix_hit_rate > 0, prefill_tokens_saved > 0)."""
+    import jax
+    import numpy as np
+
+    from theanompi_tpu.launcher import _parse_kv
+    from theanompi_tpu.models.transformer_lm import TransformerLM
+    from theanompi_tpu.serving import cli as serve_cli
+    from theanompi_tpu.utils.checkpoint import Checkpointer, model_fingerprint
+
+    tiny = ["dim=32", "heads=2", "n_layers=1", "seq_len=32", "vocab=61",
+            "dropout=0.0", "precision=fp32", "n_train=64", "n_val=32"]
+    model = TransformerLM(_parse_kv(tiny))
+    params, _ = model.init_params(jax.random.PRNGKey(0))
+    ckpt = str(tmp_path / "ckpt")
+    writer = Checkpointer(ckpt, fingerprint={
+        "mesh": {"data": 8}, "exchange": "psum_bf16_bucket", "n_subb": 1,
+        **model_fingerprint(model)})
+    writer.save(0, 5, {"params": jax.tree.map(np.asarray, params)})
+    writer.mark_clean()
+
+    out = str(tmp_path / "SERVE.json")
+    rc = serve_cli.main([
+        "--modelclass", "TransformerLM",
+        *[a for s in tiny for a in ("--set", s)],
+        "--checkpoint-dir", ckpt, "--requests", "6", "--arrival-rate", "50",
+        "--prompt-len", "4", "--max-new-tokens", "4",
+        "--max-batch", "2", "--block-size", "4",
+        "--prefix-cache", "--turns", "3", "--shared-prefix-len", "8",
+        "--out", out, "--quiet",
+    ])
+    assert rc == 0
+    art = json.load(open(out))
+    # the fields step 6c's procedure reads
+    assert art["prefix_cache"] is True
+    assert art["prefix_hit_rate"] > 0
+    assert art["prefill_tokens_saved"] > 0
+    assert art["requests"] == 6 and art["value"] > 0
+
+
 def test_runbook_serve_resilience_command(tmp_path):
     """RUNBOOK step 6b (ISSUE 14): the resilient-serving flags of the
     exact invocation — deadlines + --shed, --drain-s, --rollout-watch —

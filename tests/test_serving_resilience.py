@@ -76,7 +76,7 @@ class FakeEngine:
     def quantized(self):
         return False
 
-    def prefill(self, row, tokens, temperature=0.0, rid=0):
+    def prefill(self, row, tokens, temperature=0.0, rid=0, prefix_len=0):
         self.n_prefills += 1
         return 7, None
 

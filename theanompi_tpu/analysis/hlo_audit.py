@@ -432,11 +432,65 @@ def audit_serve_step() -> dict:
             "violations": violations, **facts}
 
 
+@functools.lru_cache(maxsize=None)
+def _serve_prefill_artifact() -> dict:
+    """Compile one partial-prefill (suffix) program — the prefix-cache
+    hit path (ISSUE 17), one-block suffix bucket; -> facts + metadata."""
+    import jax
+    import jax.numpy as jnp
+
+    from theanompi_tpu.models.transformer_lm import TransformerLM
+    from theanompi_tpu.serving.engine import InferenceEngine
+
+    model = TransformerLM(dict(SERVE_MODEL_CFG))
+    params, _ = model.init_params(jax.random.PRNGKey(0))
+    eng = InferenceEngine(model, params, block_size=8, max_batch=2)
+    s_pad = eng.block_size  # smallest suffix bucket: one block
+    fn = jax.jit(eng._prefill_suffix_impl, donate_argnums=(1, 2))
+    args = (
+        eng.params, eng._k, eng._v,
+        jnp.zeros((eng.max_blocks_per_seq,), jnp.int32),
+        jnp.zeros((s_pad // eng.block_size,), jnp.int32),
+        jnp.zeros((s_pad,), jnp.int32),
+        jnp.asarray(eng.block_size, jnp.int32),
+        jnp.asarray(eng.block_size + 1, jnp.int32),
+        jnp.asarray(0.0, jnp.float32),
+        jnp.asarray(0, jnp.int32),
+        eng._base_key,
+    )
+    text = fn.lower(*args).compile().as_text()
+    return {"s_pad": s_pad, **audit_text(text)}
+
+
+def audit_serve_prefill() -> dict:
+    """Audit the prefix-cache partial-prefill step (ISSUE 17): same
+    contract as decode — k/v pools donated (a cache hit must not copy the
+    pools to append suffix K/V), no collectives, no host callbacks."""
+    facts = _serve_prefill_artifact()
+    violations: list[str] = []
+    if facts["alias_count"] < 2:
+        violations.append(
+            f"k/v pool donation not applied in partial prefill: "
+            f"{facts['alias_count']} aliased buffers < 2 — every "
+            f"prefix-cache hit copies the whole cache")
+    if facts["collectives"]:
+        violations.append(
+            f"collectives in the partial-prefill step: "
+            f"{facts['collectives']}")
+    if facts["host_callbacks"]:
+        violations.append(
+            f"host callbacks in the partial-prefill step: "
+            f"{facts['host_callbacks']}")
+    return {"kind": "serve-prefill", "ok": not violations,
+            "violations": violations, **facts}
+
+
 # -- entry point -------------------------------------------------------------
 
 #: what ``tmlint --hlo-audit`` (and the tier-1 test) audits: the two
 #: strategies the acceptance criteria name, their overlapped-schedule
-#: locks (ISSUE 12 — the BASELINE step-7 gate), plus the serve decode step
+#: locks (ISSUE 12 — the BASELINE step-7 gate), plus the serve decode and
+#: partial-prefill (prefix-cache hit, ISSUE 17) steps
 DEFAULT_TRAIN_STRATEGIES = ("psum_bucket", "zero1")
 
 
@@ -472,6 +526,7 @@ def run_default_audits(n_data: int = 4) -> list[dict]:
     reports += [audit_overlap_schedule(s)
                 for s in DEFAULT_OVERLAP_STRATEGIES]
     reports.append(audit_serve_step())
+    reports.append(audit_serve_prefill())
     bad = [r for r in reports if not r["ok"]]
     if bad:
         err = HLOAuditError("; ".join(

@@ -127,6 +127,27 @@ class _Block(L.Layer):
         h, _ = self._apply_ffn(subs, params, {}, h, False)
         return x + h, cache
 
+    def prefill_suffix_step(self, params, x, cache, layer_idx, suffix_row,
+                            full_row, prefix_len):
+        """Partial-prefill forward of one block (ISSUE 17): ``x``
+        ``[1, S_pad, D]`` holds only the UNCACHED suffix (absolute
+        positions ``prefix_len..``); this layer's suffix K/V is written
+        into ``suffix_row``'s blocks and the queries attend over the
+        sequence's FULL row — the cached-prefix blocks included — via the
+        paged gather.  -> (y, cache')."""
+        subs = dict(self._subs())
+        attn = subs["attn"]
+        h, _ = subs["ln1"].apply(params["ln1"], {}, x)
+        q, k, v = attn.project_qkv(params["attn"], h)
+        cache = cache.write_prefill(layer_idx, k, v, suffix_row)
+        ctx = cache.attend_prefill(layer_idx, q, full_row, prefix_len)
+        h = attn.project_out(
+            params["attn"], ctx.reshape(x.shape[0], x.shape[1], -1))
+        x = x + h
+        h, _ = subs["ln2"].apply(params["ln2"], {}, x)
+        h, _ = self._apply_ffn(subs, params, {}, h, False)
+        return x + h, cache
+
     def decode_step(self, params, x, cache, layer_idx, positions):
         """One-token incremental forward of one block: appends this layer's
         K/V at ``positions`` and attends over the cached context.
@@ -315,6 +336,39 @@ class TransformerLM(SupervisedModel):
             elif isinstance(layer, _Block):
                 x, kv_cache = layer.prefill_step(p, x, kv_cache, li,
                                                  table_row)
+                li += 1
+            else:
+                x, _ = layer.apply(p, {}, x)
+        return self._head_logits(cp, x), kv_cache
+
+    def apply_prefill_partial(self, params, state, kv_cache, suffix_row,
+                              full_row, tokens, prefix_len):
+        """Partial prefill (ISSUE 17): forward ONLY the uncached suffix of
+        one sequence — ``tokens`` ``[1, S_pad]`` are the prompt's tokens
+        from absolute position ``prefix_len`` on (end-padded to whole
+        cache blocks) — while attending over the cached-prefix blocks the
+        radix cache matched into ``full_row``.  ``suffix_row`` names the
+        fresh blocks the suffix K/V lands in.  -> (logits ``[1, S_pad, V]``
+        fp32, cache').
+
+        Position embeddings index at ``prefix_len + s`` (clipped into the
+        table for end-padding positions, whose lanes are masked garbage by
+        the same causal contract as full prefill's end-padding)."""
+        del state
+        cp = self.precision.cast_to_compute(params)
+        x, li = None, 0
+        for name, layer in self._serving_layers():
+            p = cp.get(name, {})
+            if isinstance(layer, L.Embedding):
+                x = jnp.take(p["w"], tokens, axis=0)
+            elif isinstance(layer, PositionEmbedding):
+                idx = jnp.clip(prefix_len + jnp.arange(tokens.shape[1]),
+                               0, p["pos"].shape[0] - 1)
+                pos = jnp.take(p["pos"], idx, axis=0).astype(x.dtype)
+                x = x + pos[None]
+            elif isinstance(layer, _Block):
+                x, kv_cache = layer.prefill_suffix_step(
+                    p, x, kv_cache, li, suffix_row, full_row, prefix_len)
                 li += 1
             else:
                 x, _ = layer.apply(p, {}, x)

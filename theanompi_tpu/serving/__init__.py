@@ -13,7 +13,11 @@ read-only through the PR 5 verified chain and serve through:
 - :mod:`theanompi_tpu.serving.quant` — int8 weight-only quantization in the
   ``ring_int8`` per-chunk-scale + stochastic-rounding format;
 - :mod:`theanompi_tpu.serving.cli` — the ``tmserve`` entry point
-  (synthetic open-loop traffic, SERVE.json report).
+  (synthetic open-loop traffic, SERVE.json report);
+- :mod:`theanompi_tpu.serving.prefix_cache` — radix tree over full-block
+  token chunks (ISSUE 17): refcounted copy-on-write block sharing across
+  requests, longest-prefix match feeding partial prefill, LRU eviction
+  under pool pressure, params-version invalidation on live rollout.
 
 The resilience tier (ISSUE 14) adds:
 
@@ -39,6 +43,7 @@ reaches it through one lazy import of ``resilience.replica``).
 from theanompi_tpu.serving.engine import InferenceEngine, sample_tokens
 from theanompi_tpu.serving.kv_cache import BlockPool, PagedKVCache, blocks_for
 from theanompi_tpu.serving.lifecycle import RequestLog, terminal_rids
+from theanompi_tpu.serving.prefix_cache import PrefixCache
 from theanompi_tpu.serving.quant import (
     QuantizedTensor,
     dequantize_tree,
@@ -54,9 +59,9 @@ from theanompi_tpu.serving.scheduler import (
 )
 
 __all__ = [
-    "BlockPool", "InferenceEngine", "PagedKVCache", "QuantizedTensor",
-    "Request", "RequestLog", "RolloutManager", "Scheduler",
-    "TERMINAL_STATES", "blocks_for", "dequantize_tree",
+    "BlockPool", "InferenceEngine", "PagedKVCache", "PrefixCache",
+    "QuantizedTensor", "Request", "RequestLog", "RolloutManager",
+    "Scheduler", "TERMINAL_STATES", "blocks_for", "dequantize_tree",
     "newest_manifest_epoch", "quantize_tree", "run_open_loop",
     "sample_tokens", "serve_report", "terminal_rids",
 ]

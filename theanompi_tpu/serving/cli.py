@@ -71,10 +71,24 @@ def build_parser() -> argparse.ArgumentParser:
                    "(ring_int8 per-chunk-scale format)")
     p.add_argument("--top-k", type=int, default=0,
                    help="restrict sampling to the top-k logits (0 = off)")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="radix prefix cache over the KV block pool (ISSUE "
+                   "17): admissions reuse cached full-block prompt-prefix "
+                   "K/V via partial prefill; token streams are unchanged "
+                   "and the cache invalidates on live weight rollout")
     # -- synthetic traffic -------------------------------------------------
     p.add_argument("--requests", type=int, default=16)
     p.add_argument("--prompt-len", type=int, default=16,
-                   help="synthetic prompt length (tokens)")
+                   help="synthetic prompt length (tokens; with --turns>1, "
+                   "the per-turn extension length)")
+    p.add_argument("--turns", type=int, default=1,
+                   help="multi-turn sessions: each consecutive group of "
+                   "this many requests is one conversation whose turn t "
+                   "prompt extends turn t-1's by --prompt-len new tokens "
+                   "(prefix-cache traffic; 1 = independent requests)")
+    p.add_argument("--shared-prefix-len", type=int, default=0,
+                   help="identical 'system prompt' tokens prepended to "
+                   "EVERY request (cross-session prefix-cache traffic)")
     p.add_argument("--max-new-tokens", type=int, default=32)
     p.add_argument("--arrival-rate", type=float, default=0.0,
                    help="open-loop Poisson arrival rate in requests/sec "
@@ -151,25 +165,41 @@ def synthetic_requests(n: int, vocab: int, prompt_len: int,
                        max_new_tokens: int, rate: float, seed: int,
                        temperature: float = 0.0,
                        ttft_deadline_ms: float | None = None,
-                       total_deadline_ms: float | None = None):
+                       total_deadline_ms: float | None = None,
+                       turns: int = 1, shared_prefix: int = 0):
     """Seeded open-loop request stream: uniform-random prompts, Poisson
     arrivals at ``rate`` req/s (``rate=0`` = one burst at t=0).  The
     stream is a pure function of its arguments — a restarted supervised
     replica regenerates the identical stream and filters out the ids its
-    REQUESTS.jsonl already answered."""
+    REQUESTS.jsonl already answered.
+
+    Prefix-cache traffic shapes (ISSUE 17, both default off):
+    ``shared_prefix`` tokens are drawn once and prepended to EVERY prompt
+    (a shared system prompt); ``turns > 1`` groups consecutive rids into
+    sessions where turn t's prompt is turn t-1's plus ``prompt_len`` new
+    tokens — turn t re-sends the conversation so far, the traffic the
+    prefix cache exists for.  The shapes only change which tokens the
+    prompts contain; every downstream contract (rid dedup, determinism,
+    arrivals) is untouched."""
     import numpy as np
 
     from theanompi_tpu.serving.scheduler import Request
 
     rng = np.random.RandomState(seed)
+    shared = ([int(x) for x in rng.randint(0, vocab, shared_prefix)]
+              if shared_prefix > 0 else [])
     t = 0.0
     out = []
+    convo: list[int] = []
     for rid in range(n):
         if rate > 0:
             t += float(rng.exponential(1.0 / rate))
+        if turns <= 1 or rid % turns == 0:
+            convo = []
+        convo = convo + [int(x) for x in rng.randint(0, vocab, prompt_len)]
         out.append(Request(
             rid=rid,
-            prompt=[int(x) for x in rng.randint(0, vocab, prompt_len)],
+            prompt=shared + convo,
             max_new_tokens=max_new_tokens,
             temperature=temperature,
             arrival_s=t if rate > 0 else 0.0,
@@ -252,12 +282,15 @@ def serve(args) -> dict:
         num_blocks=args.num_blocks, max_batch=args.max_batch,
         quantize_int8=args.quantize_int8, top_k=args.top_k, seed=args.seed)
     sched = Scheduler(engine, telemetry=telemetry, shed=args.shed,
-                      fault_plan=fault_plan)
+                      fault_plan=fault_plan,
+                      prefix_cache=getattr(args, "prefix_cache", False))
     reqs = synthetic_requests(
         args.requests, model.data.vocab, args.prompt_len,
         args.max_new_tokens, args.arrival_rate, args.seed,
         args.temperature, ttft_deadline_ms=args.ttft_deadline_ms,
-        total_deadline_ms=args.total_deadline_ms)
+        total_deadline_ms=args.total_deadline_ms,
+        turns=getattr(args, "turns", 1),
+        shared_prefix=getattr(args, "shared_prefix_len", 0))
 
     # -- durable terminal-state log + restart dedup (ISSUE 14) -------------
     log_path = args.requests_log or (

@@ -103,6 +103,14 @@ class InferenceEngine:
         # Dh] arrays per generated token (the cache docstring's contract)
         self._decode_fn = jax.jit(self._decode_impl, donate_argnums=(1, 2))
         self._prefill_fns: dict[int, object] = {}
+        # partial-prefill programs, keyed on PADDED SUFFIX length (same
+        # power-of-two bucketing as full prefill -> same log2 bound on
+        # program count per bucket family)
+        self._prefill_suffix_fns: dict[int, object] = {}
+        # bumped on every swap_params/restore_params: cached prefix K/V was
+        # computed under the OLD weights, so the scheduler's prefix cache
+        # stamps itself against this and invalidates on mismatch (ISSUE 17)
+        self.params_version = 0
 
     @property
     def quantized(self) -> bool:
@@ -126,12 +134,17 @@ class InferenceEngine:
             params, self.quant_stats = quantize_tree(
                 params, self._quant_key, self._quant_chunk)
         self.params = params
+        self.params_version += 1
         return prev
 
     def restore_params(self, engine_params) -> None:
         """Reinstall a tree previously returned by :meth:`swap_params`
-        (already in engine format — never re-quantized)."""
+        (already in engine format — never re-quantized).  Bumps
+        ``params_version`` too: the rollback is a THIRD weight state as far
+        as cached K/V is concerned (entries cached during probation were
+        computed under the rolled-back-FROM weights)."""
         self.params = engine_params
+        self.params_version += 1
 
     # -- compiled bodies -----------------------------------------------------
     def _decode_impl(self, params, k, v, tables, lengths, tokens, temps,
@@ -160,6 +173,29 @@ class InferenceEngine:
         nxt = sample_tokens(last[None], temp[None], key[None], self.top_k)
         return nxt[0], last, cache.k, cache.v
 
+    def _prefill_suffix_impl(self, params, k, v, full_row, suffix_row,
+                             tokens, prefix_len, true_len, temp, rid,
+                             base_key):
+        """Partial prefill (ISSUE 17): ``tokens`` ``[S_pad]`` is the
+        UNCACHED suffix only; K/V and logits are computed for it alone,
+        attending over the full row (cached prefix included) via the paged
+        gather.  ``full_row`` is fixed at ``[max_blocks_per_seq]`` so the
+        program shape depends on the SUFFIX bucket only.  Sampling keys
+        stay absolute-position-derived — a partial prefill samples the
+        identical stream a full prefill (or a decode at the same position)
+        would."""
+        params = dequantize_tree(params)
+        cache = PagedKVCache(
+            k, v, jnp.zeros((1, self.max_blocks_per_seq), jnp.int32),
+            self.block_size)
+        logits, cache = self.model.apply_prefill_partial(
+            params, {}, cache, suffix_row, full_row, tokens[None, :],
+            prefix_len)
+        last = jnp.take(logits[0], true_len - prefix_len - 1, axis=0)
+        key = _sample_key(base_key, rid, true_len)
+        nxt = sample_tokens(last[None], temp[None], key[None], self.top_k)
+        return nxt[0], last, cache.k, cache.v
+
     # -- host API (the scheduler's surface) ----------------------------------
     def pad_len(self, n_tokens: int) -> int:
         """Prompt bucket: the smallest power-of-two number of blocks that
@@ -170,14 +206,24 @@ class InferenceEngine:
         return min(nb, self.max_blocks_per_seq) * self.block_size
 
     def prefill(self, table_row, tokens, temperature: float = 0.0,
-                rid: int = 0):
+                rid: int = 0, prefix_len: int = 0):
         """Prefill one sequence; -> (first generated token: int, last-
         position logits ``[V]`` np).  ``table_row``: the block ids backing
-        the prompt (padded internally with the null block)."""
+        the prompt (padded internally with the null block).
+
+        ``prefix_len > 0`` (ISSUE 17): the first ``prefix_len`` tokens'
+        K/V already sit in ``table_row``'s leading blocks (a prefix-cache
+        hit); only the suffix is computed, in a program bucketed on the
+        padded SUFFIX length.  ``prefix_len`` must be a whole number of
+        blocks (the cache shares full blocks only) and must leave at least
+        one uncached token to produce the next-token logits."""
         p = len(tokens)
         if p > self.max_context:
             raise ValueError(f"prompt of {p} tokens > max context "
                              f"{self.max_context}")
+        if prefix_len:
+            return self._prefill_suffix(table_row, tokens, temperature,
+                                        rid, prefix_len)
         p_pad = self.pad_len(p)
         if p_pad < p:
             raise ValueError(f"prompt {p} > padded bucket {p_pad}")
@@ -193,6 +239,42 @@ class InferenceEngine:
             self.params, self._k, self._v,
             jnp.asarray(row, jnp.int32), jnp.asarray(toks),
             jnp.asarray(p, jnp.int32),
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(rid, jnp.int32), self._base_key)
+        # lint: donated-escape-ok — prefill outputs are fresh XLA result
+        # buffers; only the k/v pools are donated, never sampled tokens
+        return int(nxt), np.asarray(last)
+
+    def _prefill_suffix(self, table_row, tokens, temperature, rid,
+                        prefix_len):
+        """The ``prefix_len > 0`` half of :meth:`prefill`."""
+        p = len(tokens)
+        if prefix_len % self.block_size:
+            raise ValueError(f"prefix_len {prefix_len} is not a whole "
+                             f"number of {self.block_size}-token blocks")
+        if not 0 < prefix_len < p:
+            raise ValueError(f"prefix_len {prefix_len} outside (0, {p}) — "
+                             f"at least one token must stay uncached")
+        s = p - prefix_len
+        s_pad = self.pad_len(s)
+        # the full row at FIXED width: program shape keyed on s_pad only
+        full_row = list(table_row) + [PagedKVCache.NULL_BLOCK] * (
+            self.max_blocks_per_seq - len(table_row))
+        n_prefix = prefix_len // self.block_size
+        suffix_row = list(table_row[n_prefix:]) + [
+            PagedKVCache.NULL_BLOCK] * (
+            s_pad // self.block_size - (len(table_row) - n_prefix))
+        fn = self._prefill_suffix_fns.get(s_pad)
+        if fn is None:
+            fn = self._prefill_suffix_fns[s_pad] = jax.jit(
+                self._prefill_suffix_impl, donate_argnums=(1, 2))
+        toks = np.zeros((s_pad,), np.int32)
+        toks[:s] = tokens[prefix_len:]
+        nxt, last, self._k, self._v = fn(
+            self.params, self._k, self._v,
+            jnp.asarray(full_row, jnp.int32),
+            jnp.asarray(suffix_row, jnp.int32), jnp.asarray(toks),
+            jnp.asarray(prefix_len, jnp.int32), jnp.asarray(p, jnp.int32),
             jnp.asarray(temperature, jnp.float32),
             jnp.asarray(rid, jnp.int32), self._base_key)
         # lint: donated-escape-ok — prefill outputs are fresh XLA result

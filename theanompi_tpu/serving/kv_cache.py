@@ -131,6 +131,39 @@ class PagedKVCache:
             self.v.at[layer, blk, off].set(v.astype(self.v.dtype)),
             self.block_tables, self.block_size)
 
+    # -- paged attention (suffix prefill) --------------------------------------
+    def attend_prefill(self, layer: int, q, table_row, prefix_len):
+        """Masked attention of a SUFFIX of queries over one sequence's full
+        cached context (the partial-prefill path, ISSUE 17): ``q``
+        ``[1, S_pad, H, Dh]`` — the uncached suffix starting at absolute
+        position ``prefix_len`` — attends over every position the row's
+        blocks hold, cached-prefix K/V included.  ``table_row``
+        ``[max_blocks_per_seq]`` block ids -> context ``[1, S_pad, H, Dh]``.
+
+        Same fp32 softmax / ``_NEG_INF`` mask discipline as
+        :meth:`attend_decode`; the causal mask admits absolute positions
+        ``<= prefix_len + s`` for suffix query ``s``.  End-padding queries
+        past the true suffix attend over masked-in garbage (null-block and
+        unwritten positions) — finite, never NaN, and discarded: the engine
+        samples only from the last REAL position's logits."""
+        scale = q.shape[-1] ** -0.5
+        # [nb, bs, H, Dh] -> [T_max, H, Dh]
+        kb = jnp.take(self.k[layer], table_row, axis=0)
+        vb = jnp.take(self.v[layer], table_row, axis=0)
+        t_max = kb.shape[0] * self.block_size
+        kb = kb.reshape(t_max, *kb.shape[2:])
+        vb = vb.reshape(t_max, *vb.shape[2:])
+        qf = q[0].astype(jnp.float32) * scale           # [S, H, Dh]
+        s = jnp.einsum("shd,thd->sht", qf, kb.astype(jnp.float32))
+        pos_q = prefix_len + jnp.arange(q.shape[1])     # absolute positions
+        valid = jnp.arange(t_max)[None, :] <= pos_q[:, None]
+        s = jnp.where(valid[:, None, :], s, _NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        ctx = jnp.einsum("sht,thd->shd", p, vb.astype(jnp.float32))
+        return ctx[None].astype(q.dtype)
+
     # -- paged attention (decode) --------------------------------------------
     def attend_decode(self, layer: int, q, positions):
         """Masked attention of one query token per slot over its cached
@@ -162,38 +195,73 @@ class PagedKVCache:
 
 
 class BlockPool:
-    """Host-side allocator over the pool's block ids.
+    """Host-side refcounted allocator over the pool's block ids.
 
     Block 0 (the null block) is never handed out.  ``alloc`` is
     all-or-nothing: a request that cannot get every block it asked for gets
     none (the scheduler then preempts or defers — partial grants would
-    deadlock two half-admitted sequences against each other)."""
+    deadlock two half-admitted sequences against each other).
+
+    **Refcounts (ISSUE 17)**: an ``alloc``'d block starts at refcount 1;
+    ``acquire`` bumps blocks another holder already owns (the prefix cache
+    handing cached blocks to a new request); ``free`` decrements and only
+    returns a block to the free list when its count reaches zero — so
+    evicting or preempting ONE holder of a shared prefix never invalidates
+    another.  The free *set* mirrors the free stack for O(1) double-free
+    detection (the old ``b in list`` scan was O(pool) per freed block)."""
 
     def __init__(self, num_blocks: int):
         if num_blocks < 2:
             raise ValueError("num_blocks must be >= 2")
         self.num_blocks = num_blocks
         self._free = list(range(num_blocks - 1, 0, -1))  # pop() -> low ids
+        self._free_set = set(self._free)
+        self._refs: dict[int, int] = {}  # held block -> holder count
 
     @property
     def free_blocks(self) -> int:
         return len(self._free)
+
+    def ref(self, block: int) -> int:
+        """Current holder count of ``block`` (0 = on the free list)."""
+        return self._refs.get(block, 0)
 
     def alloc(self, n: int) -> list[int] | None:
         if n < 0:
             raise ValueError(f"alloc({n})")
         if n > len(self._free):
             return None
-        return [self._free.pop() for _ in range(n)]
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._free_set.discard(b)
+            self._refs[b] = 1
+        return out
+
+    def acquire(self, blocks) -> None:
+        """Bump the refcount of blocks another holder already owns (they
+        must be live — acquiring a free block would hand out K/V nobody is
+        keeping coherent)."""
+        for b in blocks:
+            if not 0 < b < self.num_blocks:
+                raise ValueError(f"acquiring block {b} outside pool "
+                                 f"(1..{self.num_blocks - 1})")
+            if self._refs.get(b, 0) < 1:
+                raise ValueError(f"acquiring free block {b} (acquire only "
+                                 f"bumps blocks a holder already owns)")
+            self._refs[b] += 1
 
     def free(self, blocks) -> None:
         for b in blocks:
             if not 0 < b < self.num_blocks:
                 raise ValueError(f"freeing block {b} outside pool "
                                  f"(1..{self.num_blocks - 1})")
-            if b in self._free:
+            if b in self._free_set or b not in self._refs:
                 raise ValueError(f"double free of block {b}")
-            self._free.append(b)
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                self._free.append(b)
+                self._free_set.add(b)
 
 
 def blocks_for(n_tokens: int, block_size: int) -> int:

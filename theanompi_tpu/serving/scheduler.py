@@ -50,12 +50,15 @@ import numpy as np
 
 from theanompi_tpu.resilience.faults import FaultInjected, FaultPlan
 from theanompi_tpu.serving.kv_cache import BlockPool, PagedKVCache, blocks_for
+from theanompi_tpu.serving.prefix_cache import PrefixCache
 from theanompi_tpu.telemetry.metrics import (  # registered names (ISSUE 6)
     SERVE_COUNTERS,
     SERVE_HISTOGRAMS,
     SERVE_INSTANTS,
     SERVE_LIFECYCLE_COUNTERS,
     SERVE_LIFECYCLE_INSTANTS,
+    SERVE_PREFIX_COUNTERS,
+    SERVE_PREFIX_INSTANTS,
     SERVE_SPANS,
 )
 
@@ -65,6 +68,8 @@ _HIST_TOKEN_MS, _HIST_TTFT_MS = SERVE_HISTOGRAMS
 _CNT_TOKENS, _CNT_PREEMPTIONS, _CNT_REQUESTS = SERVE_COUNTERS
 _INST_EXPIRE, _INST_SHED, _INST_FAIL, _INST_DRAIN = SERVE_LIFECYCLE_INSTANTS
 _CNT_EXPIRED, _CNT_SHED, _CNT_FAILED = SERVE_LIFECYCLE_COUNTERS
+_CNT_PREFIX_HIT, _CNT_PREFIX_TOKENS = SERVE_PREFIX_COUNTERS
+(_INST_PREFIX_INVALIDATE,) = SERVE_PREFIX_INSTANTS
 
 #: every request ends in exactly one of these (ISSUE 14)
 TERMINAL_STATES = ("done", "expired", "shed", "failed")
@@ -104,18 +109,33 @@ class Scheduler:
     ``shed=True`` enables admission-time load shedding for requests that
     carry a deadline; ``fault_plan`` arms the ``serve:raise``/
     ``serve:stall`` chaos sites at decode-step ordinals (constructor-only
-    here — the CLI threads the ``THEANOMPI_FAULT_PLAN`` env through).
+    here — the CLI threads the ``THEANOMPI_FAULT_PLAN`` env through);
+    ``prefix_cache=True`` turns on the radix prefix cache over the block
+    pool (ISSUE 17): admissions reuse cached full-block prompt-prefix K/V
+    via partial prefill, finished/evicted sequences offer their full
+    blocks back, and the whole tree invalidates when the engine's
+    ``params_version`` moves (live rollout).  Token streams are unchanged
+    by the cache — bit-equal to ``prefix_cache=False`` — only the prefill
+    work is.
     """
 
     def __init__(self, engine, telemetry=None, eos_token: int | None = None,
                  shed: bool = False,
-                 fault_plan: FaultPlan | None = None):
+                 fault_plan: FaultPlan | None = None,
+                 prefix_cache: bool = False):
         self.engine = engine
         self.telemetry = telemetry
         self.eos_token = eos_token
         self.shed = shed
         self.fault_plan = fault_plan
         self.pool = BlockPool(engine.num_blocks)
+        # ISSUE 17: radix prefix cache over the pool — OFF by default (the
+        # cache-OFF token streams are the bit-equality reference)
+        self.prefix_cache = (PrefixCache(self.pool, engine.block_size)
+                             if prefix_cache else None)
+        self.n_prefix_hits = 0
+        self.n_prefix_lookups = 0
+        self.prefix_tokens_saved = 0
         self.queue: deque[Request] = deque()
         b, nb = engine.max_batch, engine.max_blocks_per_seq
         self.slots: list[Request | None] = [None] * b
@@ -230,8 +250,23 @@ class Scheduler:
         self._rids[slot] = 0
 
     def _evict(self, slot: int) -> Request:
+        """Release a slot's blocks.  With the prefix cache on, the FULL
+        blocks are offered back to the radix tree first (their K/V is
+        complete and valid — a multi-turn follow-up or this request's own
+        recompute-prefill hits them); the partial tail block stays
+        exclusive and frees normally (copy-on-write by construction:
+        shared blocks are never written again)."""
         req = self.slots[slot]
-        self.pool.free(self._blocks[slot])
+        blocks = self._blocks[slot]
+        if self.prefix_cache is not None and blocks:
+            cached = int(self._lengths[slot])  # tokens with K/V in blocks
+            n_full = cached // self.engine.block_size
+            tokens = (req.prompt + req.generated)[
+                :n_full * self.engine.block_size]
+            self.prefix_cache.insert(tokens, blocks[:n_full])
+            self.pool.free(blocks[n_full:])
+        else:
+            self.pool.free(blocks)
         self._clear_slot(slot)
         return req
 
@@ -354,8 +389,29 @@ class Scheduler:
                 n += 1
         return n
 
+    def _alloc(self, n: int) -> list[int] | None:
+        """Pool allocation with prefix-cache pressure relief: when the
+        free list can't cover ``n``, ask the radix tree to evict LRU
+        zero-ref leaves before giving up (cached-but-unreferenced blocks
+        are reclaimable capacity, not leaks)."""
+        row = self.pool.alloc(n)
+        if row is None and self.prefix_cache is not None:
+            self.prefix_cache.evict(n - self.pool.free_blocks)
+            row = self.pool.alloc(n)
+        return row
+
     def _admit(self, finished: list[Request]) -> None:
         """Prefill queued requests into free slots while blocks last."""
+        if self.prefix_cache is not None:
+            # cached K/V is only valid under the weights that computed it:
+            # a rollout swap/rollback bumped the engine's params_version,
+            # so the whole tree drops BEFORE any lookup (ISSUE 17)
+            version = self.engine.params_version
+            if self.prefix_cache.params_version != version:
+                dropped = self.prefix_cache.n_nodes
+                if self.prefix_cache.check_version(version):
+                    self._emit(_INST_PREFIX_INVALIDATE,
+                               params_version=version, dropped=dropped)
         while self.queue:
             req = self.queue[0]
             # deadline check BEFORE any prefill work (ISSUE 14 satellite):
@@ -379,16 +435,31 @@ class Scheduler:
                 self.queue.popleft()
                 self._fail(req, need, finished)
                 continue
-            row = self.pool.alloc(need)
-            if row is None:
-                if self.n_active == 0:
-                    # an empty server that still can't allocate means the
-                    # pool leaked: refuse THIS request (typed terminal)
-                    # instead of raising and killing every other request
+            matched: list[int] = []
+            prefix_len = 0
+            if self.prefix_cache is not None:
+                self.n_prefix_lookups += 1
+                matched = self.prefix_cache.match(prefix)
+                prefix_len = len(matched) * self.engine.block_size
+            new = self._alloc(need - len(matched))
+            if new is None:
+                if matched:
+                    # release the acquired prefix refs: admission failed,
+                    # and holding them would wedge the eviction pressure
+                    # valve (the tree's own refs keep the entries alive)
+                    self.pool.free(matched)
+                if self.n_active == 0 and (self.prefix_cache is None
+                                           or self.prefix_cache.n_nodes
+                                           == 0):
+                    # an empty server (and a drained cache) that still
+                    # can't allocate means the pool leaked: refuse THIS
+                    # request (typed terminal) instead of raising and
+                    # killing every other request
                     self.queue.popleft()
                     self._fail(req, need, finished)
                     continue
                 return
+            row = matched + new
             self.queue.popleft()
             span = (self.telemetry.span(_SPAN_PREFILL, request=req.rid,
                                         prompt=len(prefix), slot=slot)
@@ -399,10 +470,18 @@ class Scheduler:
                 # prefill returns a host int — already materialized, so the
                 # span close measures execution, not dispatch
                 tok, _ = self.engine.prefill(row, prefix, req.temperature,
-                                             req.rid)
+                                             req.rid, prefix_len=prefix_len)
             finally:
                 if span is not None:
                     span.__exit__(None, None, None)
+            if prefix_len:
+                # exact accounting: tokens_saved is the sum of matched-
+                # prefix lengths — prefill K/V the engine did not recompute
+                self.n_prefix_hits += 1
+                self.prefix_tokens_saved += prefix_len
+                if self.telemetry is not None:
+                    self.telemetry.count(_CNT_PREFIX_HIT)
+                    self.telemetry.count(_CNT_PREFIX_TOKENS, prefix_len)
             now = time.perf_counter()
             if req.t_first_token is None:
                 req.t_first_token = now
@@ -415,6 +494,7 @@ class Scheduler:
                 self.telemetry.count(_CNT_TOKENS)
             self._emit(_INST_ADMIT, request=req.rid, slot=slot,
                        prefix=len(prefix), blocks=need,
+                       prefix_cached=prefix_len,
                        resumed=req.n_preemptions > 0)
             req.state = "active"
             self.slots[slot] = req
@@ -651,6 +731,13 @@ def serve_report(results: dict[int, Request], wall_s: float,
         "terminal_states": states,
         "drained": scheduler.draining,
         "quantized_int8": eng.quantized,
+        # ISSUE 17 prefix-cache accounting (exact: tokens_saved is the sum
+        # of matched-prefix lengths across admissions; zeros when off)
+        "prefix_cache": scheduler.prefix_cache is not None,
+        "prefix_hit_rate": (
+            round(scheduler.n_prefix_hits / scheduler.n_prefix_lookups, 4)
+            if scheduler.n_prefix_lookups else 0.0),
+        "prefill_tokens_saved": scheduler.prefix_tokens_saved,
         "config": {
             "block_size": eng.block_size,
             "num_blocks": eng.num_blocks,

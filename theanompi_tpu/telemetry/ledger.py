@@ -136,6 +136,36 @@ def classify_artifact(name: str, payload: dict) -> list[dict]:
             return [make_record(base, "backend_unavailable", None, None,
                                 run_id=run_id, rc=payload.get("rc"))]
         return _bench_line_records(base, parsed)
+    # SERVE.json: bench.py serve-mode report.  MUST precede the bare
+    # bench-line branch — serve_report() also carries top-level
+    # ``metric``/``value``, and the generic branch would swallow it,
+    # dropping the latency percentiles and prefix-cache accounting.
+    if base.startswith("SERVE"):
+        recs = []
+        tps = payload.get("value", payload.get("tokens_per_sec"))
+        if tps is not None:
+            recs.append(make_record(base, "serve", "serve.tokens_per_sec",
+                                    tps, "tokens/sec", run_id=run_id))
+        for key in ("ttft", "token"):
+            pcts = payload.get(f"{key}_ms")
+            pcts = pcts if isinstance(pcts, dict) else {}
+            for p in ("p50", "p99"):
+                val = pcts.get(p, payload.get(f"{key}_{p}_ms"))
+                if val is not None:
+                    recs.append(make_record(base, "serve",
+                                            f"serve.{key}_{p}_ms", val,
+                                            "ms", run_id=run_id))
+        # prefix-cache accounting (ISSUE 17): only a cache-on run enters
+        # the trajectory — cache-off zeros would poison the baseline
+        if payload.get("prefix_cache"):
+            for field, unit in (("prefix_hit_rate", "rate"),
+                                ("prefill_tokens_saved", "tokens")):
+                if payload.get(field) is not None:
+                    recs.append(make_record(base, "serve",
+                                            f"serve.{field}",
+                                            payload[field], unit,
+                                            run_id=run_id))
+        return recs
     # BENCH_transformer.json / a bare bench line
     if "metric" in payload and "value" in payload:
         return _bench_line_records(base, payload)
@@ -190,18 +220,6 @@ def classify_artifact(name: str, payload: dict) -> list[dict]:
                                             f"exchange.{label}.{field}",
                                             row[field], unit,
                                             run_id=run_id))
-        return recs
-    # SERVE.json: bench.py serve-mode report
-    if base.startswith("SERVE"):
-        recs = []
-        for field, unit in (("tokens_per_sec", "tokens/sec"),
-                            ("decode_tokens_per_sec", "tokens/sec"),
-                            ("ttft_p99_ms", "ms"), ("ttft_p50_ms", "ms"),
-                            ("token_p50_ms", "ms"), ("token_p99_ms", "ms")):
-            if payload.get(field) is not None:
-                recs.append(make_record(base, "serve", f"serve.{field}",
-                                        payload[field], unit,
-                                        run_id=run_id))
         return recs
     # ATTRIB.json: per-run attribution summary (telemetry/profile.py)
     if "per_rank" in payload:

@@ -86,6 +86,20 @@ SERVE_LIFECYCLE_INSTANTS = ("serve.expire", "serve.shed", "serve.fail",
 SERVE_LIFECYCLE_COUNTERS = ("serve.expired", "serve.shed_total",
                             "serve.failed")
 
+# -- prefix-cache names (ISSUE 17) -------------------------------------------
+# The radix prefix cache over the paged KV pool accounts every hit exactly:
+# ``serve.prefix_hit`` counts admissions whose prompt matched a cached
+# full-block prefix; ``serve.prefix_tokens_saved`` accumulates the matched
+# prefix lengths — prefill K/V the engine did NOT recompute (the SERVE.json
+# ``prefill_tokens_saved`` field is this counter's end-of-run value).
+# ``serve.prefix_invalidate`` fires when the engine's ``params_version``
+# moved (a live rollout swap/rollback) and the whole tree was dropped —
+# cached K/V under old weights is silently wrong under new ones (tags:
+# params_version, dropped).  Emitted through these registered names ONLY
+# (same one-source-of-truth contract as above).
+SERVE_PREFIX_COUNTERS = ("serve.prefix_hit", "serve.prefix_tokens_saved")
+SERVE_PREFIX_INSTANTS = ("serve.prefix_invalidate",)
+
 # -- live weight-rollout names (ISSUE 14) ------------------------------------
 # ``serve.rollout``: the checkpoint-dir watcher hot-swapped a newly
 # VERIFIED checkpoint between scheduler steps (tags: from_epoch, to_epoch,
