@@ -336,7 +336,10 @@ def run_serve_bench() -> dict:
     _PREFIX_CACHE (radix prefix cache, ISSUE 17) / _TURNS (multi-turn
     sessions of this many requests each) / _SHARED_PREFIX (identical
     system-prompt tokens on every request) — the last three surface in
-    SERVE.json as prefix_hit_rate / prefill_tokens_saved.
+    SERVE.json as prefix_hit_rate / prefill_tokens_saved — /
+    _DECODE_KERNEL (on|off|auto, ISSUE 18 fused decode-attention A/B;
+    SERVE.json reports the served variant and its decode_step_ms
+    percentiles).
     """
     from theanompi_tpu.serving import cli as serve_cli
 
@@ -368,6 +371,7 @@ def run_serve_bench() -> dict:
         num_blocks=(int(env("BENCH_SERVE_BLOCKS"))
                     if env("BENCH_SERVE_BLOCKS") else None),
         quantize_int8=bool(int(env("BENCH_SERVE_QUANT", "0"))),
+        decode_kernel=env("BENCH_SERVE_DECODE_KERNEL", "auto"),
         top_k=0,
         prefix_cache=bool(int(env("BENCH_SERVE_PREFIX_CACHE", "0"))),
         requests=int(env("BENCH_SERVE_REQUESTS", "16")),

@@ -109,6 +109,47 @@ def dense_model():
     return model, params, state
 
 
+@pytest.fixture(scope="session")
+def serving_engine_factory(dense_model):
+    """Memoizing InferenceEngine factory over the session ``dense_model``
+    (ISSUE 18 satellite — tier-1 velocity): engines are keyed on their
+    construction kwargs, so every test asking for the same configuration
+    shares ONE engine and its compiled decode/prefill programs for the
+    whole tier-1 run.  Defaults are the canonical serving geometry
+    (``block_size=4, max_batch=2, seed=0``).
+
+    Shared engines are READ-ONLY above the pools: tests may prefill /
+    decode through them freely (pool contents are scratch — the position
+    masks make stale blocks invisible, the same property eviction relies
+    on) but must NOT ``swap_params`` or monkeypatch them.  Tests that
+    mutate weights (the rollout suite) pass ``shared=False`` for a
+    private engine with the same canonical construction."""
+    from theanompi_tpu.serving.engine import InferenceEngine
+
+    model, params, _state = dense_model
+    cache: dict = {}
+
+    def make(shared=True, **kw):
+        kw.setdefault("block_size", 4)
+        kw.setdefault("max_batch", 2)
+        kw.setdefault("seed", 0)
+        if not shared:
+            return InferenceEngine(model, params, **kw)
+        key = tuple(sorted(kw.items()))
+        if key not in cache:
+            cache[key] = InferenceEngine(model, params, **kw)
+        return cache[key]
+
+    return make
+
+
+@pytest.fixture(scope="session")
+def serving_engine(serving_engine_factory):
+    """The canonical shared serving engine (see
+    :func:`serving_engine_factory` for the READ-ONLY contract)."""
+    return serving_engine_factory()
+
+
 #: the checkpoint-integrity trainer config (test_checkpoint_integrity
 #: imports this as its TINY — same one-source-of-truth contract)
 WRN_TINY = {"depth": 10, "widen": 1, "batch_size": 8, "image_size": 8,

@@ -141,12 +141,28 @@ def test_overlap_schedule_audit(strategy):
     assert r["fused_chain"]["chained_same_kind"] == 0
 
 
+def test_serve_decode_kernel_audit():
+    """ISSUE 18: the decode fast path dispatches as TPU custom calls
+    (with the kernel-off lowering as the negative proof), keeps the
+    donation / zero-collective contract, and the kernel is bit-identical
+    to the fallback on CPU."""
+    r = hlo_audit.audit_serve_decode_kernel()
+    assert r["ok"], r["violations"]
+    assert r["custom_calls_on"] >= r["n_layers"]   # paged attn per layer
+    assert r["custom_calls_off"] == 0              # negative proof
+    assert r["custom_calls_int8"] >= 1             # fused int8 matmul
+    assert r["alias_count"] >= 2                   # pools stay donated
+    assert r["collectives"] == {}
+    assert r["decode_parity_bitwise"]
+    assert r["int8_rel_err"] <= hlo_audit.INT8_REL_TOL
+
+
 def test_run_default_audits_is_green():
     reports = hlo_audit.run_default_audits()
     assert [(r["kind"], r.get("strategy")) for r in reports] == [
         ("train", "psum_bucket"), ("train", "zero1"),
         ("train-overlap", "psum_bucket"), ("train-overlap", "zero1"),
-        ("serve", None), ("serve-prefill", None)]
+        ("serve", None), ("serve-prefill", None), ("serve-kernel", None)]
     assert all(r["ok"] for r in reports)
 
 
@@ -197,7 +213,7 @@ def test_budget_violation_surfaces_in_report(monkeypatch):
     # the tightened psum_bucket TRAIN lock fails — the overlap audits
     # have their own invariants and stay green
     assert [rep["ok"] for rep in ei.value.reports] == [
-        False, True, True, True, True, True]
+        False, True, True, True, True, True, True]
 
 
 def test_train_cfg_matches_the_locked_fixture():

@@ -109,10 +109,20 @@ def test_classify_serve_report():
              "unit": "tokens/sec", "run_id": "r9",
              "ttft_ms": {"p50": 11.0, "p99": 30.5},
              "token_ms": {"p50": 2.0, "p99": 4.5},
+             "decode_kernel": "kernel", "decode_step_ms": {"p50": 1.8,
+                                                           "p99": 3.9},
              "prefix_cache": True, "prefix_hit_rate": 0.72,
              "prefill_tokens_saved": 4096}
     by_metric = {r["metric"]: r for r in
                  classify_artifact("SERVE.json", serve)}
+    # ISSUE 18: decode-step wall is keyed by the served variant so the
+    # kernel-on trajectory never checks against a fallback baseline
+    assert by_metric["serve.decode.kernel.step_p99_ms"]["value"] == 3.9
+    assert by_metric["serve.decode.kernel.step_p99_ms"]["unit"] == "ms"
+    pre18 = {m for m in by_metric if "decode." in m}
+    assert {r["metric"] for r in classify_artifact(
+        "SERVE.json", {k: v for k, v in serve.items()
+                       if not k.startswith("decode")})}.isdisjoint(pre18)
     assert by_metric["serve.tokens_per_sec"]["value"] == 812.5
     assert by_metric["serve.tokens_per_sec"]["kind"] == "serve"
     assert by_metric["serve.ttft_p99_ms"]["value"] == 30.5
@@ -130,6 +140,44 @@ def test_classify_serve_report():
     # direction inference: hit rate and tokens saved improve upward
     assert not lower_is_better("serve.prefix_hit_rate", "rate")
     assert not lower_is_better("serve.prefill_tokens_saved", "tokens")
+
+
+def test_classify_roofline_report():
+    """ROOFLINE*.json (utils/roofline.py): whole-step aggregates enter
+    the trajectory; per-op rows stay out (fusion boundaries rename them
+    every compiler bump).  Label prefers the payload's ``model``, falling
+    back to the filename stem — ROOFLINE_transformer_32k.json ships
+    without a model key."""
+    roof = {"steps_profiled": 4, "device_step_ms": 97.8,
+            "time_share_at_half_roof": 0.97,
+            "time_share_at_80pct_roof": 0.85,
+            "model": "resnet50", "platform": "tpu",
+            "ops": [{"op": "fusion.1", "time_ms_per_step": 3.2}]}
+    by_metric = {r["metric"]: r for r in
+                 classify_artifact("ROOFLINE.json", roof)}
+    assert set(by_metric) == {
+        "roofline.resnet50.device_step_ms",
+        "roofline.resnet50.time_share_at_half_roof",
+        "roofline.resnet50.time_share_at_80pct_roof"}
+    assert by_metric["roofline.resnet50.device_step_ms"]["value"] == 97.8
+    assert by_metric["roofline.resnet50.device_step_ms"]["unit"] == "ms"
+    assert all(r["kind"] == "roofline" for r in by_metric.values())
+    # no per-op records ever
+    assert not any("fusion" in m for m in by_metric)
+    # model-less artifact: the filename stem names the trajectory
+    no_model = {k: v for k, v in roof.items() if k != "model"}
+    stems = {r["metric"] for r in classify_artifact(
+        "ROOFLINE_transformer_32k.json", no_model)}
+    assert "roofline.transformer_32k.device_step_ms" in stems
+    assert {r["metric"] for r in classify_artifact(
+        "ROOFLINE.json", no_model)} == {
+        "roofline.default.device_step_ms",
+        "roofline.default.time_share_at_half_roof",
+        "roofline.default.time_share_at_80pct_roof"}
+    # direction inference: step time down, roof-proximity shares up
+    assert lower_is_better("roofline.resnet50.device_step_ms", "ms")
+    assert not lower_is_better(
+        "roofline.resnet50.time_share_at_half_roof", "share")
 
 
 def test_classify_unknown_shape_yields_nothing():

@@ -155,13 +155,11 @@ def test_prefix_cache_version_stamp():
 
 # -- partial prefill (engine level) -------------------------------------------
 
-def test_partial_prefill_matches_full_prefill(dense_model):
+def test_partial_prefill_matches_full_prefill(serving_engine):
     """A prefix-cache hit reuses the cached blocks' K/V and computes the
     suffix only — same next token, same last-position logits (within
     float round-off of the paged-gather attention path)."""
-    model, params, _state = dense_model
-    engine = InferenceEngine(model, params, block_size=4, max_batch=2,
-                             seed=0)
+    engine = serving_engine
     pool = BlockPool(engine.num_blocks)
     rng = np.random.RandomState(5)
     prompt = [int(x) for x in rng.randint(0, VOCAB, 10)]
@@ -188,14 +186,14 @@ def test_partial_prefill_matches_full_prefill(dense_model):
         engine.prefill(row_a, prompt, 0.0, rid=1, prefix_len=12)
 
 
-def test_partial_prefill_program_count_is_log_bounded(dense_model):
+def test_partial_prefill_program_count_is_log_bounded(serving_engine):
     """Suffix programs bucket power-of-two on the PADDED SUFFIX length
     (the full row is fixed-width), so a serve accumulates at most
     log2(max_blocks_per_seq)+1 partial-prefill programs — compile cost
-    stays bounded no matter the prefix/suffix mix."""
-    model, params, _state = dense_model
-    engine = InferenceEngine(model, params, block_size=4, max_batch=2,
-                             seed=0)
+    stays bounded no matter the prefix/suffix mix.  Runs on the SHARED
+    session engine deliberately: the bound must hold over the whole
+    tier-1 run's accumulated suffix mix, not a fresh engine's."""
+    engine = serving_engine
     pool = BlockPool(engine.num_blocks)
     rng = np.random.RandomState(6)
     bound = int(np.log2(engine.max_blocks_per_seq)) + 1

@@ -299,6 +299,39 @@ def test_runbook_serve_prefix_cache_command(tmp_path):
     assert art["requests"] == 6 and art["value"] > 0
 
 
+def test_runbook_serve_decode_kernel_ab(tmp_path):
+    """BASELINE step 6d (ISSUE 18): the decode-kernel A/B pair — the
+    exact step-6 invocation re-run with --decode-kernel on and off — and
+    the SERVE.json fields the comparison reads (decode_kernel naming the
+    served impl, decode_step_ms percentiles per variant).  On the CPU
+    dry-run "on" resolves to the Mosaic interpreter (bit-identical to the
+    fallback by the tier-1 parity lock)."""
+    import json
+
+    from theanompi_tpu.serving import cli as serve_cli
+
+    tiny = ["dim=32", "heads=2", "n_layers=1", "seq_len=32", "vocab=61",
+            "dropout=0.0", "precision=fp32", "n_train=64", "n_val=32"]
+    impls = {}
+    for variant in ("on", "off"):
+        out = str(tmp_path / f"SERVE_{variant}.json")
+        rc = serve_cli.main([
+            "--modelclass", "TransformerLM",
+            *[a for s in tiny for a in ("--set", s)],
+            "--requests", "3", "--prompt-len", "4", "--max-new-tokens", "4",
+            "--max-batch", "2", "--block-size", "4",
+            "--decode-kernel", variant, "--out", out, "--quiet",
+        ])
+        assert rc == 0
+        art = json.load(open(out))
+        impls[variant] = art["decode_kernel"]
+        assert art["value"] > 0
+        assert "p50" in art["decode_step_ms"]
+        assert "p99" in art["decode_step_ms"]
+    assert impls["off"] == "fallback"
+    assert impls["on"] == "kernel_interpret"  # CPU host: interpreter
+
+
 def test_runbook_serve_resilience_command(tmp_path):
     """RUNBOOK step 6b (ISSUE 14): the resilient-serving flags of the
     exact invocation — deadlines + --shed, --drain-s, --rollout-watch —

@@ -84,11 +84,13 @@ def test_block_pool_alloc_free_all_or_nothing():
 
 # -- prefill/decode parity ----------------------------------------------------
 
-def _decode_parity(model, params, state, prompt_len=5, n_decode=12):
+def _decode_parity(model, params, state, prompt_len=5, n_decode=12,
+                   engine=None):
     """Drive prefill + incremental decode on slot 0; compare every decode
     step's logits against the full-forward logits at the same position."""
-    engine = InferenceEngine(model, params, block_size=4, max_batch=2,
-                             seed=0)
+    if engine is None:
+        engine = InferenceEngine(model, params, block_size=4, max_batch=2,
+                                 seed=0)
     rng = np.random.RandomState(3)
     vocab = model.data.vocab
     prompt = [int(x) for x in rng.randint(0, vocab, prompt_len)]
@@ -129,9 +131,9 @@ def _decode_parity(model, params, state, prompt_len=5, n_decode=12):
         assert int(ref[pos].argmax()) == seq[pos + 1]
 
 
-def test_prefill_decode_parity_dense(dense_model):
+def test_prefill_decode_parity_dense(dense_model, serving_engine):
     model, params, state = dense_model
-    _decode_parity(model, params, state)
+    _decode_parity(model, params, state, engine=serving_engine)
 
 
 def test_prefill_decode_parity_moe():

@@ -71,6 +71,7 @@ class FakeEngine:
         self.n_prefills = 0
         self.n_decodes = 0
         self.quant_stats = None
+        self.decode_impl = "fallback"
 
     @property
     def quantized(self):
@@ -316,17 +317,16 @@ def _manager(engine, ckpt, model, params, **kw):
                           current_epoch=0, **kw)
 
 
-def test_rollout_tolerates_half_published_then_adopts(dense_model, tmp_path):
+def test_rollout_tolerates_half_published_then_adopts(
+        dense_model, serving_engine_factory, tmp_path):
     """ISSUE 14 satellite: a manifest whose .npz is mid-replace (or still
     missing) is 'not yet published' — refused, NEVER quarantined, and the
     very same epoch adopts once its bytes verify."""
-    from theanompi_tpu.serving import InferenceEngine
-
     model, params, _ = dense_model
     ckpt = str(tmp_path / "ckpt")
     _publish(ckpt, model, params, 0)
-    engine = InferenceEngine(model, params, block_size=4, max_batch=2,
-                             seed=0)
+    # private engine: rollouts swap weights, the shared one is read-only
+    engine = serving_engine_factory(shared=False)
     mgr = _manager(engine, ckpt, model, params)
     sched = _SchedStub()
     assert newest_manifest_epoch(ckpt) == 0
@@ -360,17 +360,14 @@ def test_rollout_tolerates_half_published_then_adopts(dense_model, tmp_path):
 
 
 def test_rollout_corrupt_fault_refused_old_weights_keep_serving(
-        dense_model, tmp_path):
+        dense_model, serving_engine_factory, tmp_path):
     """serve:rollout_corrupt@0 bit-flips the FIRST candidate before
     verification: it must be refused with the old weights intact, and the
     next (ordinal 1) candidate adopts untouched."""
-    from theanompi_tpu.serving import InferenceEngine
-
     model, params, _ = dense_model
     ckpt = str(tmp_path / "ckpt")
     _publish(ckpt, model, params, 0)
-    engine = InferenceEngine(model, params, block_size=4, max_batch=2,
-                             seed=0)
+    engine = serving_engine_factory(shared=False)
     w0 = np.asarray(engine.params["head"]["w"]).copy()
     mgr = _manager(engine, ckpt, model, params,
                    fault_plan=FaultPlan.parse("serve:rollout_corrupt@0"))
@@ -389,17 +386,15 @@ def test_rollout_corrupt_fault_refused_old_weights_keep_serving(
         np.asarray(p2["params"]["head"]["w"]))
 
 
-def test_rollout_probation_rollback_and_commit(dense_model, tmp_path):
+def test_rollout_probation_rollback_and_commit(
+        dense_model, serving_engine_factory, tmp_path):
     """A critical SLO verdict inside the probation window rolls back to
     the previous weights and blacklists the epoch; a quiet probation
     commits, after which verdicts no longer matter."""
-    from theanompi_tpu.serving import InferenceEngine
-
     model, params, _ = dense_model
     ckpt = str(tmp_path / "ckpt")
     _publish(ckpt, model, params, 0)
-    engine = InferenceEngine(model, params, block_size=4, max_batch=2,
-                             seed=0)
+    engine = serving_engine_factory(shared=False)
     w0 = np.asarray(engine.params["head"]["w"]).copy()
     t = [0.0]
     verdicts = []

@@ -485,12 +485,202 @@ def audit_serve_prefill() -> dict:
             "violations": violations, **facts}
 
 
+# -- serve decode kernel dispatch (ISSUE 18) ---------------------------------
+
+#: TPU-legal shape for the kernel-dispatch lowering: the paged-decode
+#: gate needs ``head_dim % 128 == 0`` and ``heads % 8 == 0`` (fp32), and
+#: the fused int8 matmul needs 128-divisible bands — dim 1024 over 8
+#: heads at the default 1024-element quant chunk is the smallest config
+#: satisfying both.  This model is only TRACED and LOWERED (never
+#: compiled or run), so the big dims cost trace time, not compile time.
+SERVE_KERNEL_CFG = {**SERVE_MODEL_CFG, "dim": 1024, "heads": 8}
+
+
+@functools.lru_cache(maxsize=None)
+def _serve_decode_kernel_artifact() -> dict:
+    """Gather the decode-kernel dispatch facts (ISSUE 18).
+
+    Three artifact families, all produced on whatever host backend runs
+    the audit (CPU in CI):
+
+    - **TPU lowerings** of the full decode step at :data:`SERVE_KERNEL_CFG`
+      with the kernel pinned on vs off.  ``decode_impl`` is a static
+      cache field, so pinning ``"kernel"`` lowers the COMPILED pallas
+      call even on a CPU host (``lowering_platforms=("tpu",)``) — the
+      positive proof is ``tpu_custom_call`` per layer, the negative proof
+      is zero custom calls in the ``"off"`` lowering.
+    - a **direct int8 lowering** of :func:`~theanompi_tpu.ops.quant.
+      int8_matmul` over an actual quantized engine weight leaf (the
+      engine-level lowering above keeps int8 in interpret mode off-TPU,
+      so the custom call is proven at the kernel boundary).
+    - a **CPU-compiled kernel-on step** at :data:`SERVE_MODEL_CFG` plus a
+      bit-parity run: the kernel variant must keep the pool-donation /
+      zero-collective contract of :func:`audit_serve_step`, and
+      ``interpret=True`` must match the fallback BIT-for-bit over
+      crafted tables covering null-block padding, a prefix-shared block
+      and ragged (non-block-multiple) positions.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from theanompi_tpu.models.transformer_lm import TransformerLM
+    from theanompi_tpu.ops.quant import (
+        QuantizedTensor,
+        int8_matmul,
+        int8_matmul_supported,
+        quantize_chunked,
+    )
+    from theanompi_tpu.serving.engine import InferenceEngine
+    from theanompi_tpu.serving.kv_cache import PagedKVCache
+
+    facts: dict = {"n_layers": SERVE_KERNEL_CFG["n_layers"]}
+
+    # -- TPU lowerings: kernel pinned on vs off --------------------------
+    model = TransformerLM(dict(SERVE_KERNEL_CFG))
+    params, _ = model.init_params(jax.random.PRNGKey(0))
+    int8_leaf = None
+    for variant in ("on", "off"):
+        eng = InferenceEngine(model, params, block_size=8, max_batch=2,
+                              quantize_int8=True, decode_kernel=variant)
+        if variant == "on":
+            # pin the COMPILED kernel (off-TPU "on" resolves to the
+            # interpreter); static aux, so the TPU lowering is exactly
+            # what a TPU host would build
+            eng.decode_impl = "kernel"
+            int8_leaf = next(
+                w for w in jax.tree.leaves(
+                    eng.params,
+                    is_leaf=lambda x: isinstance(x, QuantizedTensor))
+                if isinstance(w, QuantizedTensor)
+                and int8_matmul_supported(w.shape, int(w.q.shape[1]),
+                                          compiled=True))
+        b = eng.max_batch
+        args = (
+            eng.params, eng._k, eng._v,
+            jnp.zeros((b, eng.max_blocks_per_seq), jnp.int32),
+            jnp.zeros((b,), jnp.int32),
+            jnp.zeros((b,), jnp.int32),
+            jnp.zeros((b,), jnp.float32),
+            jnp.zeros((b,), jnp.int32),
+            eng._base_key,
+        )
+        text = jax.jit(eng._decode_impl, donate_argnums=(1, 2)) \
+            .trace(*args).lower(lowering_platforms=("tpu",)).as_text()
+        facts[f"custom_calls_{variant}"] = text.count("tpu_custom_call")
+
+    # -- direct int8 kernel lowering over a real engine weight -----------
+    x = jnp.zeros((8, int(int8_leaf.shape[0])), jnp.float32)
+    text = jax.jit(lambda xx, ww: int8_matmul(xx, ww, interpret=False)) \
+        .trace(x, int8_leaf).lower(lowering_platforms=("tpu",)).as_text()
+    facts["custom_calls_int8"] = text.count("tpu_custom_call")
+
+    # -- CPU-compiled kernel-on step: donation contract survives ---------
+    model_s = TransformerLM(dict(SERVE_MODEL_CFG))
+    params_s, _ = model_s.init_params(jax.random.PRNGKey(0))
+    eng_s = InferenceEngine(model_s, params_s, block_size=8, max_batch=2,
+                            decode_kernel="on")
+    b = eng_s.max_batch
+    args = (
+        eng_s.params, eng_s._k, eng_s._v,
+        jnp.zeros((b, eng_s.max_blocks_per_seq), jnp.int32),
+        jnp.zeros((b,), jnp.int32),
+        jnp.zeros((b,), jnp.int32),
+        jnp.zeros((b,), jnp.float32),
+        jnp.zeros((b,), jnp.int32),
+        eng_s._base_key,
+    )
+    text = eng_s._decode_fn.lower(*args).compile().as_text()
+    facts.update(audit_text(text))
+
+    # -- bit-parity: kernel (interpret) vs fallback ----------------------
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(0x18), 4)
+    bs, h, d, nblocks = 4, 2, 16, 6
+    kpool = jax.random.normal(k1, (1, nblocks, bs, h, d), jnp.float32)
+    vpool = jax.random.normal(k2, (1, nblocks, bs, h, d), jnp.float32)
+    # slot 0 spans blocks (1, 2) with a mid-block position; slot 1 SHARES
+    # prefix block 1 (the refcounted copy-on-write case) and pads with
+    # null blocks
+    tables = jnp.asarray([[1, 2, 0], [1, 3, 0]], jnp.int32)
+    positions = jnp.asarray([6, 2], jnp.int32)
+    q = jax.random.normal(k3, (2, h, d), jnp.float32)
+    outs = {}
+    for impl in ("kernel_interpret", "fallback"):
+        cache = PagedKVCache(kpool, vpool, tables, bs, decode_impl=impl)
+        outs[impl] = cache.attend_decode(0, q, positions)
+    facts["decode_parity_bitwise"] = bool(
+        (outs["kernel_interpret"] == outs["fallback"]).all())
+
+    # -- int8 kernel vs dequantize-then-matmul tolerance -----------------
+    w = jax.random.normal(k4, (64, 24), jnp.float32)
+    qq, ss = quantize_chunked(w, jax.random.PRNGKey(7), 24)
+    qt = QuantizedTensor(qq, ss, (64, 24), jnp.dtype(jnp.float32))
+    xs = jax.random.normal(jax.random.PRNGKey(8), (3, 64), jnp.float32)
+    got = int8_matmul(xs, qt, interpret=True)
+    ref = xs @ qt.dequantize()
+    denom = float(jnp.max(jnp.abs(ref))) or 1.0
+    facts["int8_rel_err"] = float(jnp.max(jnp.abs(got - ref))) / denom
+    return facts
+
+
+#: int8 kernel vs dequantize-then-matmul: same int8 payload, so only the
+#: scale-application association differs — normal fp32 rounding, ~1e-7
+INT8_REL_TOL = 1e-5
+
+
+def audit_serve_decode_kernel() -> dict:
+    """Audit the serving decode fast path (ISSUE 18): the pallas paged
+    decode kernel and fused int8 matmul actually dispatch as TPU custom
+    calls (with the kernel-off lowering as the negative proof), the
+    kernel-on step keeps the donation / zero-collective contract, and
+    the kernel is bit-identical to the fallback on CPU."""
+    facts = _serve_decode_kernel_artifact()
+    violations: list[str] = []
+    if facts["custom_calls_on"] < facts["n_layers"]:
+        violations.append(
+            f"kernel-on TPU lowering has {facts['custom_calls_on']} "
+            f"tpu_custom_call(s) < n_layers={facts['n_layers']} — the "
+            f"paged decode kernel is not dispatching per layer")
+    if facts["custom_calls_off"] != 0:
+        violations.append(
+            f"kernel-off TPU lowering has {facts['custom_calls_off']} "
+            f"tpu_custom_call(s) — the negative proof failed, so the "
+            f"positive count above proves nothing")
+    if facts["custom_calls_int8"] < 1:
+        violations.append(
+            "int8_matmul TPU lowering has no tpu_custom_call — the "
+            "fused int8 kernel is not compiling to a Mosaic call")
+    if facts["alias_count"] < 2:
+        violations.append(
+            f"k/v pool donation not applied in the kernel-on step: "
+            f"{facts['alias_count']} aliased buffers < 2")
+    if facts["collectives"]:
+        violations.append(
+            f"collectives in the kernel-on serve step: "
+            f"{facts['collectives']}")
+    if facts["host_callbacks"]:
+        violations.append(
+            f"host callbacks in the kernel-on serve step: "
+            f"{facts['host_callbacks']}")
+    if not facts["decode_parity_bitwise"]:
+        violations.append(
+            "pallas paged decode (interpret) is NOT bit-identical to the "
+            "fallback across null blocks / shared prefix / ragged "
+            "positions")
+    if facts["int8_rel_err"] > INT8_REL_TOL:
+        violations.append(
+            f"int8 kernel deviates from dequantize-then-matmul: rel err "
+            f"{facts['int8_rel_err']:.2e} > {INT8_REL_TOL:.0e}")
+    return {"kind": "serve-kernel", "ok": not violations,
+            "violations": violations, **facts}
+
+
 # -- entry point -------------------------------------------------------------
 
 #: what ``tmlint --hlo-audit`` (and the tier-1 test) audits: the two
 #: strategies the acceptance criteria name, their overlapped-schedule
-#: locks (ISSUE 12 — the BASELINE step-7 gate), plus the serve decode and
-#: partial-prefill (prefix-cache hit, ISSUE 17) steps
+#: locks (ISSUE 12 — the BASELINE step-7 gate), plus the serve decode,
+#: partial-prefill (prefix-cache hit, ISSUE 17) and decode-kernel
+#: dispatch (ISSUE 18) steps
 DEFAULT_TRAIN_STRATEGIES = ("psum_bucket", "zero1")
 
 
@@ -527,6 +717,7 @@ def run_default_audits(n_data: int = 4) -> list[dict]:
                 for s in DEFAULT_OVERLAP_STRATEGIES]
     reports.append(audit_serve_step())
     reports.append(audit_serve_prefill())
+    reports.append(audit_serve_decode_kernel())
     bad = [r for r in reports if not r["ok"]]
     if bad:
         err = HLOAuditError("; ".join(

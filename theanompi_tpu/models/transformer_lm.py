@@ -23,6 +23,7 @@ from theanompi_tpu.models.contract import SupervisedModel
 from theanompi_tpu.models.lstm import PTBData
 from theanompi_tpu.ops import initializers as init_lib
 from theanompi_tpu.ops import layers as L
+from theanompi_tpu.ops import quant
 from theanompi_tpu.ops.attention import MultiHeadAttention, PositionEmbedding
 from theanompi_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
 from theanompi_tpu.parallel.tensor import (
@@ -303,8 +304,7 @@ class TransformerLM(SupervisedModel):
                 for i, layer in enumerate(self.net.layers)]
 
     def _head_logits(self, cp, h):
-        w = cp["head"]["w"].astype(h.dtype)
-        y = h @ w
+        y = quant.matmul_any(h, cp["head"]["w"])
         if "b" in cp["head"]:
             y = y + cp["head"]["b"].astype(h.dtype)
         return y.astype(jnp.float32)
@@ -382,7 +382,12 @@ class TransformerLM(SupervisedModel):
         cache').  Inactive batch slots ride along with their block tables
         pointed at the cache's reserved null block."""
         del state
-        cp = self.precision.cast_to_compute(params)
+        # the is_leaf fence keeps the precision policy out of int8
+        # QuantizedTensor leaves (their fp32 scales must not cast to the
+        # compute dtype) — the serving fast path feeds them through here
+        # to the fused matmul kernel (ISSUE 18)
+        cp = self.precision.cast_to_compute(
+            params, is_leaf=lambda x: isinstance(x, quant.QuantizedTensor))
         x, li = None, 0
         for name, layer in self._serving_layers():
             p = cp.get(name, {})

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 
 from theanompi_tpu.ops import initializers as init_lib
 from theanompi_tpu.ops import layers as L
+from theanompi_tpu.ops import quant
 from theanompi_tpu.parallel.mesh import SEQ_AXIS
 from theanompi_tpu.parallel.ring_attention import blockwise_attention, ring_attention
 from theanompi_tpu.parallel.tensor import (
@@ -114,15 +115,22 @@ class MultiHeadAttention(L.Layer):
         """
         b, t, _ = x.shape
         head_dim = self.dim // self.heads
-        w_qkv = jnp.concatenate(
-            [params["q"]["w"], params["k"]["w"], params["v"]["w"]], axis=1
-        ).astype(x.dtype)
-        qkv = x @ w_qkv
+        ws = [params["q"]["w"], params["k"]["w"], params["v"]["w"]]
+        if any(isinstance(w, quant.QuantizedTensor) for w in ws):
+            # int8 serving weights can't concatenate; three fused-kernel
+            # matmuls read x three times — decode is KV-DMA-bound, not
+            # qkv-bound, so the fused int8 reads still win (ISSUE 18)
+            qkv = jnp.concatenate(
+                [quant.matmul_any(x, w) for w in ws], axis=-1)
+            d_local = int(ws[0].shape[1])
+        else:
+            w_qkv = jnp.concatenate(ws, axis=1).astype(x.dtype)
+            qkv = x @ w_qkv
+            d_local = params["q"]["w"].shape[1]
         if "b" in params["q"]:
             qkv = qkv + jnp.concatenate(
                 [params["q"]["b"], params["k"]["b"], params["v"]["b"]]
             ).astype(x.dtype)
-        d_local = params["q"]["w"].shape[1]
         q = qkv[..., :d_local]
         k = qkv[..., d_local:2 * d_local]
         v = qkv[..., 2 * d_local:]

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from theanompi_tpu.ops import initializers as init_lib
+from theanompi_tpu.ops import quant
 
 Shape = tuple
 
@@ -99,7 +100,10 @@ class Dense(Layer):
         return params, {}, (*in_shape[:-1], self.units)
 
     def apply(self, params, state, x, *, train=False, rng=None):
-        y = x @ params["w"].astype(x.dtype)
+        # matmul_any: identical to ``x @ w`` for array weights; the
+        # serving fast path leaves int8 QuantizedTensor weights in the
+        # tree and this dispatch consumes them fused (ISSUE 18)
+        y = quant.matmul_any(x, params["w"])
         if self.use_bias:
             y = y + params["b"].astype(x.dtype)
         return y, state

@@ -16,12 +16,40 @@ importing the training-side exchanger (the serving lint forbids that edge):
 The exchanger's ring schedule quantizes per ring hop with these same
 helpers; serving quantizes matmul weights once at load
 (:mod:`theanompi_tpu.serving.quant`).
+
+ISSUE 18 adds the serving-side consumers of the format, kept HERE so the
+wire format and the kernel that eats it stay one module (and the kernels
+layer of ``analysis/layers.py`` owns both):
+
+- :class:`QuantizedTensor` — one quantized matmul weight as a pytree node
+  (moved from ``serving/quant.py``, which re-exports it);
+- :func:`int8_matmul` — a fused Pallas matmul that consumes the int8
+  chunks DIRECTLY: the per-chunk fp32 scales ride the activation into the
+  MXU dot (they vary along the contraction axis, so they must be applied
+  before the accumulate), and the fp32 weight tensor the old
+  dequantize-then-matmul materialized every step never exists;
+- :func:`matmul_any` — the dispatch point the layer stack calls:
+  ``x @ w`` for plain arrays, the fused kernel for supported
+  :class:`QuantizedTensor` leaves, dequantize-then-matmul otherwise.
+
+The chunked flat layout maps onto a 2D matmul without moving bytes: with
+``W [Din, Dout]`` flattened row-major, either each chunk spans whole rows
+(``chunk %% Dout == 0`` — one scale per row band, a single kernel band) or
+each row spans whole chunks (``Dout %% chunk == 0`` — ``Dout // chunk``
+column bands, per-row scales within each).  Both are metadata-only
+reshapes of the wire payload, which is what keeps ``ring_int8``'s bytes
+byte-identical.  Shapes satisfying neither (e.g. a 61-vocab test head)
+fall back to dequantize-then-matmul via :func:`matmul_any`.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import functools
+
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
 
 
 def quantize_chunk(x: jax.Array, key: jax.Array):
@@ -57,3 +85,148 @@ def dequantize_chunked(q: jax.Array, scales: jax.Array, shape, dtype):
 
     flat = (q.astype(jnp.float32) * scales[:, None]).reshape(-1)
     return flat[: int(np.prod(shape, dtype=np.int64))].reshape(shape).astype(dtype)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QuantizedTensor:
+    """One quantized leaf: ``q [n_chunks, chunk]`` int8 + ``scales
+    [n_chunks]`` fp32, with the original shape/dtype as static aux data."""
+
+    q: jax.Array
+    scales: jax.Array
+    shape: tuple
+    dtype: object
+
+    def tree_flatten(self):
+        return (self.q, self.scales), (self.shape, str(self.dtype))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], jnp.dtype(aux[1]))
+
+    def dequantize(self) -> jax.Array:
+        return dequantize_chunked(self.q, self.scales, self.shape,
+                                  self.dtype)
+
+    @property
+    def nbytes_quantized(self) -> int:
+        return int(self.q.size + 4 * self.scales.size)
+
+
+# ---------------------------------------------------------------------------
+# fused int8 weight matmul (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+def _int8_mm_kernel(x_ref, q_ref, s_ref, o_ref):
+    """One column band: scale the activation by the band's per-row scales
+    (fp32, on the VPU), then one MXU dot against the raw int8 tile."""
+    xs = x_ref[:, :].astype(jnp.float32) * s_ref[0, :][None, :]
+    qt = q_ref[:, :]
+    if o_ref.dtype == jnp.bfloat16:
+        # bf16 activations keep the MXU at its bf16 rate; fp32 runs exact
+        xs, qt = xs.astype(jnp.bfloat16), qt.astype(jnp.bfloat16)
+    else:
+        qt = qt.astype(jnp.float32)
+    o_ref[:, :] = jax.lax.dot_general(
+        xs, qt, dimension_numbers=((((1,), (0,)), ((), ()))),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def _band_layout(qt: QuantizedTensor):
+    """Metadata-only view of the chunked wire payload as ``(q2d [Din,
+    Dout] int8, scales [bands, Din] fp32, bands)``; ``None`` when the
+    chunking does not tile the 2D shape (see module docstring)."""
+    if len(qt.shape) != 2:
+        return None
+    din, dout = (int(s) for s in qt.shape)
+    chunk = int(qt.q.shape[1])
+    if chunk % dout == 0:
+        # row bands: each chunk covers chunk // Dout whole rows
+        q2d = qt.q.reshape(-1, dout)[:din]
+        srow = jnp.repeat(qt.scales, chunk // dout)[:din]
+        return q2d, srow[None, :], 1
+    if dout % chunk == 0:
+        # column bands: each row is Dout // chunk consecutive chunks
+        bands = dout // chunk
+        return qt.q.reshape(din, dout), qt.scales.reshape(din, bands).T, bands
+    return None
+
+
+def int8_matmul_supported(shape, chunk_elems: int,
+                          compiled: bool = False) -> bool:
+    """Whether :func:`int8_matmul` can consume a ``[Din, Dout]`` weight
+    quantized at ``chunk_elems``: the chunking must tile the 2D shape,
+    and the COMPILED kernel additionally needs Mosaic-tileable bands
+    (``interpret=True`` parity tests take any tiling shape)."""
+    if len(shape) != 2:
+        return False
+    din, dout = (int(s) for s in shape)
+    if chunk_elems % dout and dout % chunk_elems:
+        return False
+    if compiled:
+        band_cols = dout if chunk_elems % dout == 0 else chunk_elems
+        return din % 128 == 0 and band_cols % 128 == 0
+    return True
+
+
+def int8_matmul(x, qt: QuantizedTensor, interpret: bool | None = None):
+    """``x @ dequantize(qt)`` without materializing the fp32 weight:
+    ``x [..., Din]`` -> ``[..., Dout]`` in ``x.dtype``.
+
+    Grid over column bands; per band the kernel holds the full ``[M,
+    Din]`` activation (decode batches are tiny), the band's raw int8
+    tile, and its per-row scales.  ``interpret=None`` auto-selects like
+    the attention kernels.  Tolerance vs dequantize-then-matmul: the
+    scale application associates ``(x * s) @ q`` instead of ``x @ (s *
+    q)``, so results differ by normal fp rounding (~1e-7 relative, locked
+    in tests), never by quantization error — both consume the same int8
+    payload."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    layout = _band_layout(qt)
+    if layout is None:
+        raise ValueError(
+            f"int8_matmul: chunking {qt.q.shape[1]} does not tile shape "
+            f"{qt.shape}; gate with int8_matmul_supported()")
+    q2d, scales, bands = layout
+    din, dout = q2d.shape
+    lead = x.shape[:-1]
+    x2 = x.reshape(-1, din)
+    m = x2.shape[0]
+    m_pad = -(-m // 8) * 8  # sublane-align the batch; pad rows drop below
+    if m_pad != m:
+        x2 = jnp.concatenate(
+            [x2, jnp.zeros((m_pad - m, din), x2.dtype)], axis=0)
+    scales_bd = jnp.broadcast_to(scales[:, None, :], (bands, 8, din))
+    cc = dout // bands
+    out = pl.pallas_call(
+        _int8_mm_kernel,
+        grid=(bands,),
+        in_specs=[
+            pl.BlockSpec((m_pad, din), lambda b: (0, 0)),
+            pl.BlockSpec((din, cc), lambda b: (0, b)),
+            pl.BlockSpec((None, 8, din), lambda b: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((m_pad, cc), lambda b: (0, b)),
+        out_shape=jax.ShapeDtypeStruct((m_pad, dout), x.dtype),
+        interpret=interpret,
+    )(x2, q2d, scales_bd)
+    return out[:m].reshape(*lead, dout)
+
+
+def matmul_any(x, w, interpret: bool | None = None):
+    """The layer stack's matmul dispatch: plain ``x @ w`` for arrays, the
+    fused int8 kernel for supported :class:`QuantizedTensor` leaves,
+    dequantize-then-matmul for the rest.  A param tree that was fully
+    dequantized upstream (the non-kernel serving path, and every training
+    path) never reaches the isinstance branch, so this is free there."""
+    if isinstance(w, QuantizedTensor):
+        compiled = (jax.default_backend() == "tpu"
+                    and interpret is not True)
+        if int8_matmul_supported(w.shape, int(w.q.shape[1]),
+                                 compiled=compiled):
+            return int8_matmul(x, w, interpret)
+        w = w.dequantize()
+    return x @ w.astype(x.dtype)

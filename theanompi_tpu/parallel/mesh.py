@@ -179,8 +179,13 @@ class Precision:
     compute_dtype: Any = jnp.bfloat16
     output_dtype: Any = jnp.float32
 
-    def cast_to_compute(self, tree):
-        return jax.tree.map(self._cast(self.compute_dtype), tree)
+    def cast_to_compute(self, tree, is_leaf=None):
+        """``is_leaf`` lets callers fence off opaque pytree nodes the
+        policy must pass through whole — the serving fast path's
+        ``QuantizedTensor`` leaves carry fp32 scales that must NOT cast
+        to bf16 (this layer stays import-free, so the fence is generic)."""
+        return jax.tree.map(self._cast(self.compute_dtype), tree,
+                            is_leaf=is_leaf)
 
     def cast_to_param(self, tree):
         return jax.tree.map(self._cast(self.param_dtype), tree)
@@ -193,9 +198,14 @@ class Precision:
         def cast(x):
             # result_type (not isinstance) so numpy arrays and Python floats
             # in a host-initialized params pytree are cast too, instead of
-            # silently passing through the policy.
-            if jnp.issubdtype(jnp.result_type(x), jnp.floating):
-                return jnp.asarray(x, dtype)
+            # silently passing through the policy.  Leaves with no array
+            # interpretation (an is_leaf-fenced QuantizedTensor) pass
+            # through untouched.
+            try:
+                if jnp.issubdtype(jnp.result_type(x), jnp.floating):
+                    return jnp.asarray(x, dtype)
+            except TypeError:  # lint: swallow-ok — non-array leaf (QuantizedTensor), policy passes it through
+                pass
             return x
 
         return cast

@@ -29,6 +29,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from theanompi_tpu.ops import layers as L
+from theanompi_tpu.ops import quant
 from theanompi_tpu.parallel.mesh import MODEL_AXIS
 
 
@@ -145,7 +146,7 @@ class RowParallelDense(L.Dense):
         return "rpdense"
 
     def apply(self, params, state, x, *, train=False, rng=None):
-        y = x @ params["w"].astype(x.dtype)
+        y = quant.matmul_any(x, params["w"])
         y = psum_fwd_identity_bwd(y, MODEL_AXIS)
         if self.use_bias:
             y = y + params["b"].astype(x.dtype)

@@ -71,6 +71,13 @@ def build_parser() -> argparse.ArgumentParser:
                    "(ring_int8 per-chunk-scale format)")
     p.add_argument("--top-k", type=int, default=0,
                    help="restrict sampling to the top-k logits (0 = off)")
+    p.add_argument("--decode-kernel", default="auto",
+                   choices=("on", "off", "auto"),
+                   help="fused paged-attention decode kernel (ISSUE 18): "
+                   "on forces the pallas path (Mosaic interpreter off-TPU "
+                   "— bit-identical, A/B and parity runs), off pins the "
+                   "pure-JAX fallback, auto compiles it on TPU when the "
+                   "head geometry tiles and falls back otherwise")
     p.add_argument("--prefix-cache", action="store_true",
                    help="radix prefix cache over the KV block pool (ISSUE "
                    "17): admissions reuse cached full-block prompt-prefix "
@@ -280,10 +287,21 @@ def serve(args) -> dict:
     engine = InferenceEngine(
         model, params, block_size=args.block_size,
         num_blocks=args.num_blocks, max_batch=args.max_batch,
-        quantize_int8=args.quantize_int8, top_k=args.top_k, seed=args.seed)
+        quantize_int8=args.quantize_int8, top_k=args.top_k, seed=args.seed,
+        decode_kernel=getattr(args, "decode_kernel", "auto"))
     sched = Scheduler(engine, telemetry=telemetry, shed=args.shed,
                       fault_plan=fault_plan,
                       prefix_cache=getattr(args, "prefix_cache", False))
+    if telemetry is not None:
+        from theanompi_tpu.telemetry.metrics import (
+            SERVE_DECODE_KERNEL_INSTANTS,
+        )
+
+        # ISSUE 18: record the resolved decode path once per run — the
+        # A/B trace needs to know WHICH impl produced its decode spans
+        telemetry.instant(SERVE_DECODE_KERNEL_INSTANTS[0],
+                          impl=engine.decode_impl,
+                          requested=getattr(args, "decode_kernel", "auto"))
     reqs = synthetic_requests(
         args.requests, model.data.vocab, args.prompt_len,
         args.max_new_tokens, args.arrival_rate, args.seed,
@@ -351,12 +369,19 @@ def serve(args) -> dict:
     if engine.quant_stats:
         report["quantization"] = engine.quant_stats
     if telemetry is not None:
-        from theanompi_tpu.telemetry.metrics import SERVE_GAUGES
+        from theanompi_tpu.telemetry.metrics import (
+            SERVE_DECODE_KERNEL_GAUGES,
+            SERVE_GAUGES,
+        )
 
         g_tps, g_active, g_free = SERVE_GAUGES
         telemetry.gauge(g_tps, report["value"])
         telemetry.gauge(g_active, 0)
         telemetry.gauge(g_free, sched.pool.free_blocks)
+        step_p50 = (report.get("decode_step_ms") or {}).get("p50")
+        if step_p50 is not None:
+            telemetry.gauge(SERVE_DECODE_KERNEL_GAUGES[0], step_p50,
+                            impl=engine.decode_impl)
         telemetry.close()
         telemetry.export_chrome_trace(
             os.path.join(args.telemetry_dir, "trace.json"))

@@ -30,6 +30,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from theanompi_tpu.ops.pallas_paged_attention import paged_decode_supported
+from theanompi_tpu.ops.quant import int8_matmul_supported
 from theanompi_tpu.serving.kv_cache import PagedKVCache, blocks_for
 from theanompi_tpu.serving.quant import (
     dequantize_tree,
@@ -69,7 +71,8 @@ class InferenceEngine:
     def __init__(self, model, params, *, block_size: int = 16,
                  num_blocks: int | None = None, max_batch: int = 8,
                  quantize_int8: bool = False, quant_chunk: int = 1024,
-                 top_k: int = 0, seed: int = 0):
+                 top_k: int = 0, seed: int = 0,
+                 decode_kernel: str = "auto"):
         cfg = model.config
         self.model = model
         self.max_batch = int(max_batch)
@@ -82,6 +85,35 @@ class InferenceEngine:
         self.top_k = int(top_k)
         self._base_key = jax.random.PRNGKey(seed)
         self.quant_stats = None
+        # the serving fast path (ISSUE 18): "auto" takes the pallas paged
+        # decode kernel + fused int8 matmuls on TPU when the shape gate
+        # admits them, the pure-JAX paths otherwise; "on" forces the
+        # kernels (interpreter off-TPU — the parity locks run exactly
+        # this); "off" forces the fallback.
+        if decode_kernel not in ("auto", "on", "off"):
+            raise ValueError(f"decode_kernel={decode_kernel!r} not in "
+                             f"('auto', 'on', 'off')")
+        self.decode_kernel = decode_kernel
+        heads, dim = cfg["heads"], cfg["dim"]
+        on_tpu = jax.default_backend() == "tpu"
+        use_kernel = decode_kernel == "on" or (
+            decode_kernel == "auto" and on_tpu and paged_decode_supported(
+                heads, dim // heads, model.precision.compute_dtype))
+        #: resolved decode-attention variant — "kernel" (compiled pallas,
+        #: TPU), "kernel_interpret" (same kernel through the pallas
+        #: interpreter, the off-TPU "on" mode the parity locks run) or
+        #: "fallback".  SERVE.json and the serve.decode_kernel gauge
+        #: report whether the kernel tier is active.
+        self.decode_impl = (
+            ("kernel" if on_tpu else "kernel_interpret")
+            if use_kernel else "fallback")
+        # int8 leaves the fused matmul can consume stay quantized inside
+        # the decode step; the rest (odd-vocab head, MoE stacks)
+        # dequantize as before.  None = dequantize everything.
+        self._keep_quant = (
+            (lambda qt: int8_matmul_supported(
+                qt.shape, int(qt.q.shape[1]), compiled=on_tpu))
+            if use_kernel else None)
         # kept for swap_params: a live weight rollout must re-quantize the
         # incoming tree EXACTLY as __init__ did (same key, same chunking)
         self._quantize_int8 = bool(quantize_int8)
@@ -91,12 +123,12 @@ class InferenceEngine:
             params, self.quant_stats = quantize_tree(
                 params, self._quant_key, quant_chunk)
         self.params = params
-        heads, dim = cfg["heads"], cfg["dim"]
         cache = PagedKVCache.create(
             n_layers=cfg["n_layers"], num_blocks=self.num_blocks,
             block_size=block_size, heads=heads, head_dim=dim // heads,
             max_batch=max_batch, max_context=self.max_context,
-            dtype=model.precision.compute_dtype)
+            dtype=model.precision.compute_dtype,
+            decode_impl=self.decode_impl)
         self._k, self._v = cache.k, cache.v
         # k/v pools are donated: the step's .at[].set() writes update the
         # pool buffers in place instead of copying two [L, blocks, bs, H,
@@ -149,8 +181,12 @@ class InferenceEngine:
     # -- compiled bodies -----------------------------------------------------
     def _decode_impl(self, params, k, v, tables, lengths, tokens, temps,
                      rids, base_key):
-        params = dequantize_tree(params)
-        cache = PagedKVCache(k, v, tables, self.block_size)
+        # fast path keeps kernel-consumable int8 leaves quantized; the
+        # fallback dequantizes everything exactly as before (the PR 9
+        # argmax-agreement lock rides on that path staying bit-stable)
+        params = dequantize_tree(params, keep=self._keep_quant)
+        cache = PagedKVCache(k, v, tables, self.block_size,
+                             decode_impl=self.decode_impl)
         # the incoming token's 0-based position == tokens already cached
         positions = lengths
         logits, cache = self.model.apply_decode(

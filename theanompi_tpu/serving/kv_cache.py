@@ -24,7 +24,14 @@ go through this module at all: it runs inside the prompt through
 ``MultiHeadAttention.attend`` (:mod:`theanompi_tpu.ops.attention`), i.e. the
 pallas flash kernels of ``ops/pallas_attention.py`` whenever the shape gate
 admits them — on TPU the O(P²) half of serving rides the same kernels as
-training, and only the O(P) per-token decode uses the gather path below.
+training.  The O(P) per-token decode has two implementations selected by
+the static ``decode_impl`` field (ISSUE 18): the pure-JAX blockwise gather
+below (``"fallback"``), and the fused pallas kernel of
+``ops/pallas_paged_attention.py`` (``"kernel"``) whose block table drives
+the DMA index_map directly.  Both compute the SAME blockwise
+online-softmax recurrence in the same op order, so they are bit-identical
+on CPU (`interpret=True`) — the parity lock the HLO audit and
+tests/test_paged_decode_kernel.py enforce.
 """
 
 from __future__ import annotations
@@ -33,6 +40,8 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+
+from theanompi_tpu.ops.pallas_paged_attention import paged_attend_decode
 
 _NEG_INF = -1e30
 
@@ -54,15 +63,24 @@ class PagedKVCache:
     v: jax.Array             # [L, num_blocks, block_size, H, Dh]
     block_tables: jax.Array  # [max_batch, max_blocks_per_seq] int32
     block_size: int
+    #: decode attention implementation, static: "fallback" (pure-JAX
+    #: blockwise gather), "kernel" (compiled pallas paged decode) or
+    #: "kernel_interpret" (same kernel, pallas interpreter — the CPU
+    #: parity-lock mode).  Static aux, so each variant compiles its own
+    #: program; compiled-vs-interpret is pinned here rather than sniffed
+    #: from the backend at trace time so a CPU host can still lower the
+    #: compiled variant for TPU (the HLO audit does exactly that).
+    decode_impl: str = "fallback"
 
     NULL_BLOCK = 0
 
     def tree_flatten(self):
-        return (self.k, self.v, self.block_tables), (self.block_size,)
+        return ((self.k, self.v, self.block_tables),
+                (self.block_size, self.decode_impl))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children, block_size=aux[0])
+        return cls(*children, block_size=aux[0], decode_impl=aux[1])
 
     # -- shape properties ----------------------------------------------------
     @property
@@ -80,10 +98,13 @@ class PagedKVCache:
     @classmethod
     def create(cls, n_layers: int, num_blocks: int, block_size: int,
                heads: int, head_dim: int, max_batch: int,
-               max_context: int, dtype=jnp.float32) -> "PagedKVCache":
+               max_context: int, dtype=jnp.float32,
+               decode_impl: str = "fallback") -> "PagedKVCache":
         if num_blocks < 2:
             raise ValueError("num_blocks must be >= 2 (block 0 is the "
                              "reserved null block)")
+        if decode_impl not in ("fallback", "kernel", "kernel_interpret"):
+            raise ValueError(f"unknown decode_impl {decode_impl!r}")
         max_blocks_per_seq = -(-max_context // block_size)
         shape = (n_layers, num_blocks, block_size, heads, head_dim)
         return cls(
@@ -92,13 +113,15 @@ class PagedKVCache:
             block_tables=jnp.zeros((max_batch, max_blocks_per_seq),
                                    jnp.int32),
             block_size=block_size,
+            decode_impl=decode_impl,
         )
 
     def with_tables(self, tables) -> "PagedKVCache":
         """New cache view with the given ``[max_batch, max_blocks]`` tables
         (the scheduler re-materializes these from host state each step)."""
         return PagedKVCache(self.k, self.v,
-                            jnp.asarray(tables, jnp.int32), self.block_size)
+                            jnp.asarray(tables, jnp.int32), self.block_size,
+                            decode_impl=self.decode_impl)
 
     # -- writes --------------------------------------------------------------
     def write_prefill(self, layer: int, k, v, table_row) -> "PagedKVCache":
@@ -115,7 +138,8 @@ class PagedKVCache:
         return PagedKVCache(
             self.k.at[layer, idx].set(blocks_k.astype(self.k.dtype)),
             self.v.at[layer, idx].set(blocks_v.astype(self.v.dtype)),
-            self.block_tables, self.block_size)
+            self.block_tables, self.block_size,
+            decode_impl=self.decode_impl)
 
     def write_decode(self, layer: int, k, v, positions) -> "PagedKVCache":
         """Append one token's K/V per batch slot: ``k``/``v`` ``[B, H, Dh]``
@@ -129,7 +153,8 @@ class PagedKVCache:
         return PagedKVCache(
             self.k.at[layer, blk, off].set(k.astype(self.k.dtype)),
             self.v.at[layer, blk, off].set(v.astype(self.v.dtype)),
-            self.block_tables, self.block_size)
+            self.block_tables, self.block_size,
+            decode_impl=self.decode_impl)
 
     # -- paged attention (suffix prefill) --------------------------------------
     def attend_prefill(self, layer: int, q, table_row, prefix_len):
@@ -174,24 +199,64 @@ class PagedKVCache:
         ``<= positions[b]``.  Inactive slots (position 0 pointing at the
         null block) attend over one garbage token — finite garbage out,
         discarded by the scheduler, and crucially never NaN (an all-masked
-        softmax would poison the lane)."""
-        scale = q.shape[-1] ** -0.5
-        # [B, nb, bs, H, Dh] -> [B, T_max, H, Dh]
+        softmax would poison the lane).
+
+        ``decode_impl == "kernel"`` dispatches to the fused pallas kernel
+        (:mod:`theanompi_tpu.ops.pallas_paged_attention`); the default is
+        the pure-JAX masked gather below, restructured (ISSUE 18) from one
+        global softmax into the blockwise online-softmax recurrence so the
+        two paths share an op-for-op schedule and stay BIT-identical on
+        CPU (a fully-masked block is an exact no-op of the recurrence:
+        correction ``exp(0) == 1``, masked probabilities underflow to 0 —
+        so the kernel gating trailing null blocks off changes nothing).
+        The recurrence equals the old single softmax to ~1e-7 (the
+        running max ends at the global max; only the rounding association
+        of the normalizer differs), which test_paged_decode_kernel.py pins
+        against the verbatim old formula."""
+        if self.decode_impl != "fallback":
+            return paged_attend_decode(
+                self.k[layer], self.v[layer], self.block_tables,
+                self.block_size, q, jnp.asarray(positions, jnp.int32),
+                interpret=(self.decode_impl == "kernel_interpret"))
+        # [B, nb, bs, H, Dh]: gather each slot's blocks, then run the
+        # recurrence over the block axis
         kb = jnp.take(self.k[layer], self.block_tables, axis=0)
         vb = jnp.take(self.v[layer], self.block_tables, axis=0)
-        b = q.shape[0]
-        t_max = self.max_context
-        kb = kb.reshape(b, t_max, *kb.shape[3:])
-        vb = vb.reshape(b, t_max, *vb.shape[3:])
-        qf = q.astype(jnp.float32) * scale
-        s = jnp.einsum("bhd,bthd->bht", qf, kb.astype(jnp.float32))
-        valid = jnp.arange(t_max)[None, :] <= positions[:, None]
-        s = jnp.where(valid[:, None, :], s, _NEG_INF)
-        m = jnp.max(s, axis=-1, keepdims=True)
-        p = jnp.exp(s - m)
-        p = p / jnp.sum(p, axis=-1, keepdims=True)
-        ctx = jnp.einsum("bht,bthd->bhd", p, vb.astype(jnp.float32))
-        return ctx.astype(q.dtype)
+        b, h, d = q.shape
+        bs = self.block_size
+        nb = self.block_tables.shape[1]
+        qf = q.astype(jnp.float32) * (d ** -0.5)
+
+        # multiply+reduce, NOT einsum/dot: gemm kernels change their
+        # accumulation strategy with batching layout, which breaks
+        # bit-parity with the pallas kernel's per-head products; sum/max
+        # reductions over an explicit axis are order-stable (see the
+        # kernel module docstring)
+        def body(j, carry):
+            m, l, acc = carry
+            k_j = jax.lax.dynamic_index_in_dim(kb, j, axis=1,
+                                               keepdims=False)
+            v_j = jax.lax.dynamic_index_in_dim(vb, j, axis=1,
+                                               keepdims=False)
+            s = jnp.sum(k_j.astype(jnp.float32) * qf[:, None, :, :],
+                        axis=-1)                               # [B, bs, H]
+            t_abs = j * bs + jnp.arange(bs)
+            valid = t_abs[None, :, None] <= positions[:, None, None]
+            s = jnp.where(valid, s, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            corr = jnp.exp(m - m_new)                          # [B, 1, H]
+            p = jnp.exp(s - m_new)                             # [B, bs, H]
+            l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+            ctx = jnp.sum(p[..., None] * v_j.astype(jnp.float32),
+                          axis=1)                              # [B, H, Dh]
+            acc_new = acc * jnp.swapaxes(corr, 1, 2) + ctx
+            return m_new, l_new, acc_new
+
+        m0 = jnp.full((b, 1, h), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, 1, h), jnp.float32)
+        a0 = jnp.zeros((b, h, d), jnp.float32)
+        m, l, acc = jax.lax.fori_loop(0, nb, body, (m0, l0, a0))
+        return (acc / jnp.swapaxes(l, 1, 2)).astype(q.dtype)
 
 
 class BlockPool:

@@ -23,12 +23,13 @@ weights (tiny, routing-critical) stay in their checkpoint dtype.
 
 from __future__ import annotations
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 
-from theanompi_tpu.ops.quant import dequantize_chunked, quantize_chunked
+from theanompi_tpu.ops.quant import (  # noqa: F401  (QuantizedTensor
+    QuantizedTensor,  # re-exported: it moved to ops/quant.py in ISSUE 18
+    quantize_chunked,  # so the fused int8 kernel and the wire format it
+)  # consumes live in one kernels-layer module
 
 #: default elements per quantization chunk (one fp32 scale each): small
 #: enough that a tiny test model gets real per-chunk granularity, large
@@ -39,33 +40,6 @@ DEFAULT_CHUNK_ELEMS = 1024
 _MATMUL_LEAF_NAMES = ("w", "up_w", "down_w")
 #: path components whose subtrees never quantize
 _SKIP_COMPONENTS = ("embedding", "positionembedding", "gate")
-
-
-@jax.tree_util.register_pytree_node_class
-@dataclasses.dataclass
-class QuantizedTensor:
-    """One quantized leaf: ``q [n_chunks, chunk]`` int8 + ``scales
-    [n_chunks]`` fp32, with the original shape/dtype as static aux data."""
-
-    q: jax.Array
-    scales: jax.Array
-    shape: tuple
-    dtype: object
-
-    def tree_flatten(self):
-        return (self.q, self.scales), (self.shape, str(self.dtype))
-
-    @classmethod
-    def tree_unflatten(cls, aux, children):
-        return cls(children[0], children[1], aux[0], jnp.dtype(aux[1]))
-
-    def dequantize(self) -> jax.Array:
-        return dequantize_chunked(self.q, self.scales, self.shape,
-                                  self.dtype)
-
-    @property
-    def nbytes_quantized(self) -> int:
-        return int(self.q.size + 4 * self.scales.size)
 
 
 def _should_quantize(path, leaf) -> bool:
@@ -103,14 +77,26 @@ def quantize_tree(params, key, chunk_elems: int = DEFAULT_CHUNK_ELEMS,
     return jax.tree_util.tree_unflatten(treedef, out), stats
 
 
-def dequantize_tree(params):
+def dequantize_tree(params, keep=None):
     """Materialize fp-typed weights from a (possibly) quantized tree.
     Identity on unquantized leaves; call INSIDE jit so XLA fuses the
-    dequant into the consuming matmuls."""
+    dequant into the consuming matmuls.
+
+    ``keep`` (ISSUE 18): a predicate over :class:`QuantizedTensor` leaves
+    to RETAIN quantized — the serving fast path keeps every leaf the
+    fused int8 kernel can consume (``ops.quant.int8_matmul_supported``)
+    and dequantizes only the stragglers (odd-vocab heads, 3D MoE expert
+    stacks)."""
+
+    def _leaf(leaf):
+        if isinstance(leaf, QuantizedTensor):
+            if keep is not None and keep(leaf):
+                return leaf
+            return leaf.dequantize()
+        return leaf
+
     return jax.tree_util.tree_map(
-        lambda leaf: leaf.dequantize()
-        if isinstance(leaf, QuantizedTensor) else leaf,
-        params, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+        _leaf, params, is_leaf=lambda x: isinstance(x, QuantizedTensor))
 
 
 def is_quantized_tree(params) -> bool:

@@ -147,6 +147,7 @@ class Scheduler:
         self._rids = np.zeros((b,), np.int32)
         self.n_steps = 0
         self.token_ms: list[float] = []
+        self.step_ms: list[float] = []  # one entry per decode step
         self.ttft_ms: list[float] = []
         self.n_preemptions = 0
         self.n_expired = 0
@@ -583,6 +584,7 @@ class Scheduler:
                 span.__exit__(None, None, None)
         t1 = time.perf_counter()
         step_ms = (t1 - t0) * 1e3
+        self.step_ms.append(step_ms)
         self.n_steps += 1
         self._rate.append((t1, len(active)))
         for slot in active:
@@ -728,6 +730,10 @@ def serve_report(results: dict[int, Request], wall_s: float,
         "token_ms": pct(scheduler.token_ms),
         "preemptions": scheduler.n_preemptions,
         "decode_steps": scheduler.n_steps,
+        # ISSUE 18 kernel A/B: which decode path served this run, plus its
+        # per-step wall percentiles — the variant key the ledger trends
+        "decode_kernel": eng.decode_impl,
+        "decode_step_ms": pct(scheduler.step_ms),
         "terminal_states": states,
         "drained": scheduler.draining,
         "quantized_int8": eng.quantized,

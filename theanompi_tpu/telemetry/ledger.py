@@ -47,7 +47,7 @@ DEFAULT_TOLERANCE = 0.10
 BACKFILL_PATTERNS = ("BENCH_r*.json", "BENCH_mfu_ladder.json",
                      "BENCH_transformer.json", "BENCH_unavailable.json",
                      "SCALING*.json", "EXCHANGE*.json", "SERVE*.json",
-                     "ATTRIB.json")
+                     "ROOFLINE*.json", "ATTRIB.json")
 
 #: unit substrings that mean lower-is-better; everything else (rates,
 #: mfu, efficiency, shares) improves upward
@@ -155,6 +155,17 @@ def classify_artifact(name: str, payload: dict) -> list[dict]:
                     recs.append(make_record(base, "serve",
                                             f"serve.{key}_{p}_ms", val,
                                             "ms", run_id=run_id))
+        # ISSUE 18 decode-kernel A/B: per-step wall is keyed BY VARIANT so
+        # a kernel-on run never regresses against a fallback baseline
+        variant = payload.get("decode_kernel")
+        step_pcts = payload.get("decode_step_ms")
+        if variant and isinstance(step_pcts, dict):
+            for p in ("p50", "p99"):
+                if step_pcts.get(p) is not None:
+                    recs.append(make_record(
+                        base, "serve",
+                        f"serve.decode.{variant}.step_{p}_ms",
+                        step_pcts[p], "ms", run_id=run_id))
         # prefix-cache accounting (ISSUE 17): only a cache-on run enters
         # the trajectory — cache-off zeros would poison the baseline
         if payload.get("prefix_cache"):
@@ -220,6 +231,30 @@ def classify_artifact(name: str, payload: dict) -> list[dict]:
                                             f"exchange.{label}.{field}",
                                             row[field], unit,
                                             run_id=run_id))
+        return recs
+    # ROOFLINE*.json: utils/roofline.py per-op roofline report.  Only the
+    # whole-step aggregates enter the trajectory — per-op rows churn with
+    # every fusion-boundary change and would drown check() in renames.
+    if isinstance(payload.get("ops"), list) and "device_step_ms" in payload:
+        label = payload.get("model")
+        if not label:
+            stem = base[:-5] if base.endswith(".json") else base
+            label = (stem[len("ROOFLINE_"):]
+                     if stem.startswith("ROOFLINE_") else "default")
+        recs = []
+        if payload.get("device_step_ms") is not None:
+            recs.append(make_record(base, "roofline",
+                                    f"roofline.{label}.device_step_ms",
+                                    payload["device_step_ms"], "ms",
+                                    run_id=run_id))
+        # roof-proximity shares: the fraction of step time spent at
+        # >= half / >= 80% of the relevant roof — up is good
+        for field in ("time_share_at_half_roof", "time_share_at_80pct_roof"):
+            if payload.get(field) is not None:
+                recs.append(make_record(base, "roofline",
+                                        f"roofline.{label}.{field}",
+                                        payload[field], "share",
+                                        run_id=run_id))
         return recs
     # ATTRIB.json: per-run attribution summary (telemetry/profile.py)
     if "per_rank" in payload:

@@ -86,6 +86,19 @@ SERVE_LIFECYCLE_INSTANTS = ("serve.expire", "serve.shed", "serve.fail",
 SERVE_LIFECYCLE_COUNTERS = ("serve.expired", "serve.shed_total",
                             "serve.failed")
 
+# -- decode-kernel names (ISSUE 18) ------------------------------------------
+# The fused paged-attention decode path (``ops/pallas_paged_attention.py``)
+# is a per-run static choice, so it emits exactly once per serve run:
+# ``serve.decode_kernel`` instants at startup record the RESOLVED impl
+# (tags: impl — "kernel" | "kernel_interpret" | "fallback", requested —
+# the --decode-kernel flag value); ``serve.decode_kernel.step_p50_ms``
+# gauges the per-decode-step wall p50 at close, next to the existing
+# SERVE_GAUGES — tagged with the impl so an A/B pair in one telemetry dir
+# stays attributable.  Emitted through these registered names ONLY (same
+# one-source-of-truth contract as above).
+SERVE_DECODE_KERNEL_INSTANTS = ("serve.decode_kernel",)
+SERVE_DECODE_KERNEL_GAUGES = ("serve.decode_kernel.step_p50_ms",)
+
 # -- prefix-cache names (ISSUE 17) -------------------------------------------
 # The radix prefix cache over the paged KV pool accounts every hit exactly:
 # ``serve.prefix_hit`` counts admissions whose prompt matched a cached
