@@ -387,6 +387,61 @@ def run_serve_bench() -> dict:
     return serve_cli.serve(args)
 
 
+def run_router_bench() -> dict:
+    """BENCH_ROUTER mode (ISSUE 19): multi-replica serving through the
+    tmrouter fleet pool; -> the ROUTER.json report dict.
+
+    Replicas are real tmserve subprocesses leased from a fleet ledger in
+    BENCH_ROUTER_FLEET_DIR (default: a fresh dir next to this file —
+    wiped per run so stale leases never block the pool).  Knobs (all
+    optional): BENCH_ROUTER_REQUESTS / _REPLICAS / _MIN_REPLICAS /
+    _MAX_REPLICAS / _DEVICES (gang lease per replica) / _POOL (device
+    pool size) / _RATE (req/s, 0 = burst) / _NEW / _PROMPT / _TURNS
+    (sticky conversations) / _SET (semicolon-separated model k=v pairs
+    over the CPU-sized bench transformer).  The report lands in
+    ROUTER.json (p50/p99 router-visible TTFT, tokens/sec, the replica
+    trajectory, the exactly-once audit) and the perf ledger.
+    """
+    import shutil
+
+    from theanompi_tpu.router import cli as router_cli
+
+    env = os.environ.get
+    fleet_dir = env("BENCH_ROUTER_FLEET_DIR")
+    if not fleet_dir:
+        fleet_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "fleet_router_bench")
+        shutil.rmtree(fleet_dir, ignore_errors=True)
+    model_set = [
+        "dim=64", "heads=1", "n_layers=2", "seq_len=64", "vocab=256",
+        "dropout=0.0", "precision=fp32",
+    ]
+    for pair in (env("BENCH_ROUTER_SET", "") or "").split(";"):
+        if pair.strip():
+            model_set.append(pair.strip())
+    args = router_cli.build_parser().parse_args(["--fleet-dir", fleet_dir])
+    vars(args).update(
+        pool_size=(int(env("BENCH_ROUTER_POOL"))
+                   if env("BENCH_ROUTER_POOL") else None),
+        replicas=int(env("BENCH_ROUTER_REPLICAS", "2")),
+        min_replicas=(int(env("BENCH_ROUTER_MIN_REPLICAS"))
+                      if env("BENCH_ROUTER_MIN_REPLICAS") else None),
+        max_replicas=int(env("BENCH_ROUTER_MAX_REPLICAS", "2")),
+        replica_devices=int(env("BENCH_ROUTER_DEVICES", "1")),
+        model_set=model_set,
+        requests=int(env("BENCH_ROUTER_REQUESTS", "8")),
+        prompt_len=int(env("BENCH_ROUTER_PROMPT", "8")),
+        max_new_tokens=int(env("BENCH_ROUTER_NEW", "8")),
+        arrival_rate=float(env("BENCH_ROUTER_RATE", "0")),
+        turns=int(env("BENCH_ROUTER_TURNS", "1")),
+        seed=int(env("BENCH_SEED", "0")),
+        timeout_s=float(env("BENCH_ROUTER_TIMEOUT", "600")),
+        telemetry_dir=env("BENCH_TELEMETRY_DIR") or None,
+        out=None, quiet=True,
+    )
+    return router_cli.run_router(args)
+
+
 def _ledger_append(payload: dict, source: str) -> None:
     """ISSUE 16: append one published artifact to PERF_LEDGER.jsonl next
     to this file — every publish site calls through here (including the
@@ -425,6 +480,21 @@ def _measure():
             json.dump(out, f, indent=1)
         os.replace(path + ".tmp", path)
         _ledger_append(out, "SERVE.json")
+        print(json.dumps(out))
+        return
+    if os.environ.get("BENCH_ROUTER"):
+        # multi-replica router bench (ISSUE 19): same atomic-publish +
+        # ledger contract as the serve bench, ROUTER.json artifact
+        run_id = (time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+                  + f"-p{os.getpid()}")
+        out = run_router_bench()
+        out["run_id"] = run_id
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "ROUTER.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump(out, f, indent=1)
+        os.replace(path + ".tmp", path)
+        _ledger_append(out, "ROUTER.json")
         print(json.dumps(out))
         return
     model_name = os.environ.get("BENCH_MODEL", "resnet50")

@@ -459,7 +459,8 @@ def test_fleet_telemetry_names_registered():
 
     assert set(FLEET_INSTANTS) == {"fleet.schedule", "fleet.preempt",
                                    "fleet.resume", "fleet.complete",
-                                   "fleet.fail", "fleet.hang"}
+                                   "fleet.fail", "fleet.hang",
+                                   "fleet.drain"}
 
 
 def test_fleet_fault_grammar():

@@ -643,7 +643,7 @@ time.sleep(120)
                for v in rec.failure_cause["health"])
     # the ledger remembers WHY long after the record is gone
     led = DeviceLedger(d)
-    assert led.failures["wedged"]["cause"] == "hang"
+    assert led.last_failure("wedged")["cause"] == "hang"
     events = read_fleet_events(d)
     hangs = [e for e in events if e["event"] == "fleet.hang"]
     assert len(hangs) == 1 and hangs[0]["job"] == "wedged"

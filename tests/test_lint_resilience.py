@@ -69,7 +69,8 @@ def test_swallow_allowlist_still_names_the_documented_sites():
     assert ("theanompi_tpu/serving/cli.py", "main") in SWALLOW_ALLOWLIST
     assert ("theanompi_tpu/analysis/cli.py", "main") in SWALLOW_ALLOWLIST
     assert ("theanompi_tpu/fleet/cli.py", "main") in SWALLOW_ALLOWLIST
-    assert len(SWALLOW_ALLOWLIST) == 6
+    assert ("theanompi_tpu/router/cli.py", "main") in SWALLOW_ALLOWLIST
+    assert len(SWALLOW_ALLOWLIST) == 7
 
 
 def test_faultinject_marker_registered():

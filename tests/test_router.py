@@ -1,0 +1,813 @@
+"""ISSUE 19: multi-replica serving router + autoscaler on the fleet ledger.
+
+Unit matrix on milliseconds-fast fakes — no subprocess and no XLA
+anywhere in this file except the two fleet-lifecycle tests that drive
+``python -c`` serving fakes through the real scheduler:
+
+- the durable lifecycle file contracts (queue tailing with torn tails,
+  the drain sentinel, atomic snapshot publish/throttle);
+- the balancer (least-wait choice, conversation stickiness + the
+  stick-factor escape hatch, forget-on-death);
+- the autoscale hysteresis state machine on an injected clock (sustain
+  windows, cooldown, the TTFT-SLO fast path, min/max bounds);
+- the ledger failure-history cap (satellite: last K causes per job, a
+  bounded job set, the dropped-count witness, pre-19 shape back-compat);
+- serving-kind fleet jobs (spec validation, the tmserve child command,
+  drain-to-done classification, serving-never-a-preemption-victim);
+- ``run_queue_loop`` on the FakeEngine (durable admission, restart
+  dedup, queue-wait accounting, both drain paths);
+- the Router itself against hand-written replica dirs (exactly-once
+  harvest, duplicate audit, drain give-backs, death absorption +
+  backfill, pressure, the ROUTER.json report).
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.fleet import (
+    DeviceLedger,
+    FleetScheduler,
+    JobSpec,
+    JobSpecError,
+    build_child_cmd,
+    job_dir,
+    read_fleet_events,
+    read_record,
+)
+from theanompi_tpu.fleet.ledger import FAILURES_JOBS, FAILURES_PER_JOB
+from theanompi_tpu.resilience import EXIT_CLEAN
+from theanompi_tpu.router import (
+    AutoscaleConfig,
+    AutoscalePolicy,
+    Balancer,
+    ReplicaPool,
+    Router,
+    est_wait_s,
+)
+from theanompi_tpu.router import cli as router_cli
+from theanompi_tpu.serving.kv_cache import blocks_for
+from theanompi_tpu.serving.lifecycle import (
+    DRAIN_OP,
+    RequestLog,
+    SnapshotPublisher,
+    append_queue,
+    drain_entry,
+    publish_snapshot,
+    read_jsonl_since,
+    read_snapshot,
+    request_drain,
+    terminal_records,
+    terminal_rids,
+)
+from theanompi_tpu.serving.scheduler import Request, Scheduler, run_queue_loop
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- the durable lifecycle files ----------------------------------------------
+
+def test_read_jsonl_since_tails_only_complete_lines(tmp_path):
+    p = str(tmp_path / "q.jsonl")
+    append_queue(p, [{"rid": 0}, {"rid": 1}])
+    recs, off = read_jsonl_since(p, 0)
+    assert [r["rid"] for r in recs] == [0, 1]
+    # nothing new: offset parks
+    recs2, off2 = read_jsonl_since(p, off)
+    assert recs2 == [] and off2 == off
+    # a torn tail (no newline) is "not there yet" — NOT consumed
+    with open(p, "a") as f:
+        f.write('{"rid": 2')
+    recs3, off3 = read_jsonl_since(p, off)
+    assert recs3 == [] and off3 == off
+    # the writer finishes the line: now it appears exactly once
+    with open(p, "a") as f:
+        f.write('}\n')
+    recs4, off4 = read_jsonl_since(p, off3)
+    assert [r["rid"] for r in recs4] == [2] and off4 > off3
+    # a complete-but-corrupt line is skipped AND consumed (never valid)
+    with open(p, "a") as f:
+        f.write('{"rid": oops}\n')
+        f.write('{"rid": 3}\n')
+    recs5, _ = read_jsonl_since(p, off4)
+    assert [r["rid"] for r in recs5] == [3]
+    # missing file: empty, offset unchanged
+    assert read_jsonl_since(str(tmp_path / "nope"), 7) == ([], 7)
+
+
+def test_queue_drain_sentinel_and_request_drain(tmp_path):
+    p = str(tmp_path / "q.jsonl")
+    assert drain_entry() == {"op": DRAIN_OP}
+    append_queue(p, [{"rid": 5}])
+    request_drain(p)
+    recs, _ = read_jsonl_since(p, 0)
+    assert recs == [{"rid": 5}, {"op": DRAIN_OP}]
+
+
+def test_snapshot_publish_read_and_absent(tmp_path):
+    p = str(tmp_path / "SERVE_SNAPSHOT.json")
+    assert read_snapshot(p) is None
+    publish_snapshot(p, {"backlog_tokens": 12, "token_rate": 80.0})
+    snap = read_snapshot(p)
+    assert snap["backlog_tokens"] == 12
+    assert not os.path.exists(p + ".tmp")  # atomic: no debris
+    with open(p, "w") as f:
+        f.write("{torn")
+    assert read_snapshot(p) is None  # unreadable -> None, never raises
+
+
+def test_snapshot_publisher_throttles_on_steps_and_wall(tmp_path):
+    p = str(tmp_path / "snap.json")
+    pub = SnapshotPublisher(p, every_steps=4, min_interval_s=3600.0)
+    calls = []
+
+    def snap_fn():
+        calls.append(1)
+        return {"n": len(calls)}
+
+    assert pub.maybe(snap_fn, 0)          # first call always due
+    assert not pub.maybe(snap_fn, 1)      # neither steps nor wall due
+    assert not pub.maybe(snap_fn, 3)
+    assert pub.maybe(snap_fn, 4)          # step cadence
+    assert pub.maybe(snap_fn, 4, force=True)   # final-publish override
+    assert read_snapshot(p) == {"n": 3}
+    # the wall-interval path keeps an IDLE loop publishing freshness
+    pub2 = SnapshotPublisher(p, every_steps=10**9, min_interval_s=0.0)
+    assert pub2.maybe(snap_fn, 0) and pub2.maybe(snap_fn, 0)
+
+
+def test_request_log_records_latency_and_extras(tmp_path):
+    p = str(tmp_path / "REQUESTS.jsonl")
+    log = RequestLog(p, attempt=2)
+    req = Request(rid=7, prompt=[1, 2], max_new_tokens=4)
+    req.state, req.reason, req.generated = "done", None, [5, 5]
+    req.t_submit, req.t_first_token = 10.0, 10.25
+    log.record(req, queue_wait_ms=33.5)
+    log.close()
+    (rec,) = terminal_records(p)
+    assert rec["rid"] == 7 and rec["attempt"] == 2
+    assert rec["ttft_ms"] == pytest.approx(250.0)
+    assert rec["queue_wait_ms"] == 33.5 and rec["n_generated"] == 2
+    assert terminal_rids(p) == {7}
+
+
+# -- balancer -----------------------------------------------------------------
+
+def test_est_wait_uses_worst_of_router_and_snapshot_backlog():
+    # the router's owed ledger and the replica's own snapshot can skew
+    # (in-flight queue appends): balance on the WORSE of the two
+    assert est_wait_s(100, {"backlog_tokens": 40, "token_rate": 50.0}) == 2.0
+    assert est_wait_s(10, {"backlog_tokens": 80, "token_rate": 40.0}) == 2.0
+    # no snapshot / no measured rate: the configured default rate
+    assert est_wait_s(100, None, default_rate=50.0) == 2.0
+    assert est_wait_s(100, {"token_rate": None}, default_rate=25.0) == 4.0
+
+
+def test_balancer_picks_least_wait_and_sticks_conversations():
+    b = Balancer(stick_factor=2.0, stick_slack_s=0.0)
+    jid, sticky = b.choose({"a": 1.0, "b": 0.4}, convo=9)
+    assert jid == "b" and not sticky          # first touch binds
+    jid, sticky = b.choose({"a": 0.5, "b": 0.6}, convo=9)
+    assert jid == "b" and sticky              # held: within 2x of best
+    jid, sticky = b.choose({"a": 0.1, "b": 0.9}, convo=9)
+    assert jid == "a" and not sticky          # too far behind: rebind
+    # no conversation: pure least-wait, ties break deterministically
+    assert b.choose({"x": 0.2, "y": 0.2}) == ("x", False)
+    with pytest.raises(ValueError):
+        b.choose({})
+
+
+def test_balancer_forget_replica_drops_its_conversations():
+    b = Balancer()
+    b.choose({"a": 0.1, "b": 5.0}, convo=1)
+    b.choose({"a": 0.1, "b": 5.0}, convo=2)
+    b.choose({"a": 5.0, "b": 0.1}, convo=3)
+    assert b.forget_replica("a") == 2
+    # rebinding after the death is fresh, not sticky
+    jid, sticky = b.choose({"b": 0.1}, convo=1)
+    assert jid == "b" and not sticky
+
+
+# -- autoscale hysteresis -----------------------------------------------------
+
+def _policy(**kw):
+    clock = {"t": 0.0}
+    cfg = AutoscaleConfig(**{
+        "min_replicas": 1, "max_replicas": 4, "up_pressure_s": 4.0,
+        "up_after_s": 1.0, "down_pressure_s": 0.5, "down_after_s": 2.0,
+        "cooldown_s": 2.0, **kw})
+    return AutoscalePolicy(cfg, clock=lambda: clock["t"]), clock
+
+
+def test_autoscale_up_requires_sustained_pressure():
+    pol, clock = _policy()
+    assert pol.observe(1, 10.0) is None        # spike begins
+    clock["t"] = 0.9
+    assert pol.observe(1, 10.0) is None        # not sustained yet
+    clock["t"] = 1.0
+    assert pol.observe(1, 10.0) == "up"        # 1.0s above: scale
+    clock["t"] = 1.5
+    assert pol.observe(2, 10.0) is None        # cooldown gates
+    # the spike that began DURING cooldown (t=1.5) is credited once the
+    # cooldown ends: at t=3.0 it has already sustained 1.5s
+    clock["t"] = 3.0
+    assert pol.observe(2, 10.0) == "up"
+
+
+def test_autoscale_band_clears_windows_and_down_needs_sustain():
+    pol, clock = _policy(cooldown_s=0.0)
+    pol.observe(2, 10.0)
+    clock["t"] = 0.6
+    pol.observe(2, 2.0)                        # inside the band: reset
+    clock["t"] = 1.6
+    assert pol.observe(2, 10.0) is None        # window restarted at 1.6
+    # down: below 0.5 sustained for 2.0s
+    clock["t"] = 2.0
+    assert pol.observe(2, 0.1) is None
+    clock["t"] = 3.9
+    assert pol.observe(2, 0.1) is None
+    clock["t"] = 4.0
+    assert pol.observe(2, 0.1) == "down"
+
+
+def test_autoscale_slo_breach_skips_the_sustain_wait():
+    pol, clock = _policy(ttft_slo_ms=500.0, cooldown_s=0.0)
+    # pressure fine, p99 blown: scale immediately (damage is happening)
+    assert pol.observe(1, 1.0, ttft_p99_ms=900.0) == "up"
+    # and an SLO breach at max_replicas still respects the bound
+    assert pol.observe(4, 1.0, ttft_p99_ms=900.0) is None
+
+
+def test_autoscale_respects_bounds():
+    pol, clock = _policy(min_replicas=2, max_replicas=2, cooldown_s=0.0)
+    clock["t"] = 10.0
+    assert pol.observe(2, 100.0) is None       # at max: never up
+    clock["t"] = 20.0
+    assert pol.observe(2, 0.0) is None         # at min: never down
+    with pytest.raises(ValueError, match="max_replicas"):
+        AutoscaleConfig(min_replicas=3, max_replicas=2).validate()
+    with pytest.raises(ValueError, match="hysteresis"):
+        AutoscaleConfig(down_pressure_s=5.0, up_pressure_s=4.0).validate()
+
+
+# -- ledger failure-history cap (satellite) -----------------------------------
+
+def test_ledger_failures_bounded_per_job_with_dropped_witness(tmp_path):
+    led = DeviceLedger(str(tmp_path), 8)
+    for i in range(FAILURES_PER_JOB + 2):
+        led.record_failure("j", {"cause": f"c{i}"})
+    entry = led.failures["j"]
+    assert [c["cause"] for c in entry["causes"]] == \
+        [f"c{i}" for i in range(2, FAILURES_PER_JOB + 2)]
+    assert entry["dropped"] == 2               # the witness: 2 fell off
+    assert led.last_failure("j")["cause"] == f"c{FAILURES_PER_JOB + 1}"
+    assert led.last_failure("ghost") is None
+
+
+def test_ledger_failures_bounded_across_jobs_and_persisted(tmp_path):
+    d = str(tmp_path / "pool")
+    led = DeviceLedger(d, 8)
+    for i in range(FAILURES_JOBS + 3):
+        led.record_failure(f"job-{i:03d}", {"cause": "crash"})
+    assert len(led.failures) == FAILURES_JOBS
+    assert led.failures_dropped == 3           # oldest jobs evicted
+    assert "job-000" not in led.failures and "job-002" not in led.failures
+    assert f"job-{FAILURES_JOBS + 2:03d}" in led.failures
+    # the cap and the witness survive a reopen
+    re = DeviceLedger(d)
+    assert len(re.failures) == FAILURES_JOBS
+    assert re.failures_dropped == 3
+    # and keeps evicting with a continuous sequence after the reload
+    re.record_failure("late", {"cause": "hang"})
+    assert len(re.failures) == FAILURES_JOBS and re.failures_dropped == 4
+
+
+def test_ledger_failures_pre19_shape_back_compat(tmp_path):
+    d = str(tmp_path / "pool")
+    led = DeviceLedger(d, 8)
+    led.persist()
+    # hand-write the pre-19 shape: job -> bare cause dict
+    path = os.path.join(d, "ledger.json")
+    state = json.load(open(path))
+    state["failures"] = {"old": {"cause": "crash", "exit_code": 70}}
+    with open(path, "w") as f:
+        json.dump(state, f)
+    re = DeviceLedger(d)
+    assert re.last_failure("old")["cause"] == "crash"
+    assert re.failures["old"]["dropped"] == 0
+    re.record_failure("old", {"cause": "hang"})  # appends, no crash
+    assert [c["cause"] for c in re.failures["old"]["causes"]] == \
+        ["crash", "hang"]
+
+
+# -- serving-kind fleet jobs --------------------------------------------------
+
+def test_jobspec_kind_validation_and_serving_child_cmd(tmp_path):
+    with pytest.raises(JobSpecError, match="kind"):
+        JobSpec(job_id="x", kind="batch").validate()
+    spec = JobSpec(job_id="r0", kind="serving",
+                   modelfile="theanompi_tpu.models.transformer_lm",
+                   modelclass="TransformerLM",
+                   model_config={"dim": 32, "precision": "fp32"},
+                   extra_args=["--drain-s", "2"])
+    spec.validate()
+    jdir = str(tmp_path / "jobs" / "r0")
+    cmd = build_child_cmd(spec, 2, jdir)
+    assert cmd[:3] == [sys.executable, "-m", "theanompi_tpu.serving"]
+    assert "--queue-file" in cmd
+    assert cmd[cmd.index("--queue-file") + 1] == \
+        os.path.join(jdir, "queue.jsonl")
+    assert cmd[cmd.index("--requests-log") + 1] == \
+        os.path.join(jdir, "REQUESTS.jsonl")
+    assert "--set" in cmd and "precision='fp32'" in cmd
+    assert cmd[-2:] == ["--drain-s", "2"]
+    # restart continuity is REQUESTS.jsonl dedup: no training resume flags
+    assert build_child_cmd(spec, 2, jdir, resume=True) == cmd
+    assert "--resume" not in build_child_cmd(spec, 2, jdir, resume=True)
+
+
+#: a serving fake: runs until SIGTERM (the drain_job path) or until the
+#: durable drain sentinel appears in its queue file, then exits CLEAN —
+#: the shape of a replica finishing in-flight work on request
+_SERVE_FAKE = r'''
+import json, os, signal, sys, time
+jdir = os.environ["THEANOMPI_JOB_DIR"]
+signal.signal(signal.SIGTERM, lambda s, f: sys.exit(0))
+open(os.path.join(jdir, "replica.ready"), "w").write("1")
+q = os.path.join(jdir, "queue.jsonl")
+deadline = time.time() + 30
+while time.time() < deadline:
+    try:
+        if any('"op": "drain"' in line for line in open(q)):
+            sys.exit(0)
+    except OSError:
+        pass
+    time.sleep(0.01)
+sys.exit(1)
+'''
+
+
+def _wait_replica_ready(pool, jid, timeout_s=30.0):
+    """Wait past the supervisor's startup window: ``running`` status only
+    means the supervisor launched; the ready file means the child has its
+    SIGTERM handler installed (draining earlier is a kill, not a drain)."""
+    ready = os.path.join(pool.jdir(jid), "replica.ready")
+    deadline = time.perf_counter() + timeout_s
+    while time.perf_counter() < deadline:
+        if os.path.exists(ready) and pool.status(jid) == "running":
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"{jid} never became ready")
+
+
+def _serving_fake_spec(**kw):
+    return {"priority": kw.pop("priority", 10),
+            "min_devices": kw.pop("min_devices", 2),
+            "max_devices": kw.pop("max_devices", 2),
+            "max_restarts": 0, "backoff_base": 0.1,
+            "argv": [sys.executable, "-c", _SERVE_FAKE], **kw}
+
+
+def test_fleet_drain_job_classifies_serving_done(tmp_path):
+    """drain_job SIGTERMs a running replica through its supervisor; the
+    replica exits 0 — and despite ``preempted=True`` on the job result
+    (the supervisor DID terminate it) the serving episode classifies
+    DONE, never requeued."""
+    d = str(tmp_path / "fleet")
+    sched = FleetScheduler(d, 8, poll_s=0.01, telemetry=False)
+    pool = ReplicaPool(sched, _serving_fake_spec())
+    jid = pool.spawn()
+    box = {}
+    t = threading.Thread(target=lambda: box.setdefault("rc", sched.run()))
+    t.start()
+    _wait_replica_ready(pool, jid)
+    assert sched.drain_job(jid)
+    t.join(30)
+    assert not t.is_alive() and box["rc"] == EXIT_CLEAN
+    rec = read_record(d, jid)
+    assert rec.status == "done" and rec.preemptions == 0
+    assert rec.last_exit == 0
+    names = [e["event"] for e in read_fleet_events(d)]
+    assert "fleet.drain" in names and "fleet.complete" in names
+    assert "fleet.preempt" not in names
+    assert not sched.drain_job(jid)  # already terminal: no-op
+
+
+def test_fleet_serving_replica_never_a_preemption_victim(tmp_path):
+    """A low-priority serving replica holding the whole pool is NOT
+    preempted by a high-priority training job — training waits until the
+    replica drains (the inverse of the training-victim path)."""
+    d = str(tmp_path / "fleet")
+    sched = FleetScheduler(d, 8, poll_s=0.01, telemetry=False)
+    pool = ReplicaPool(sched, _serving_fake_spec(
+        priority=0, min_devices=8, max_devices=8))
+    jid = pool.spawn()
+    box = {}
+    t = threading.Thread(target=lambda: box.setdefault("rc", sched.run()))
+    t.start()
+    _wait_replica_ready(pool, jid)
+    sched.submit(JobSpec(job_id="urgent-train", priority=100,
+                         min_devices=8, max_restarts=0,
+                         argv=[sys.executable, "-c", "pass"]))
+    time.sleep(0.3)  # several scheduler passes
+    with sched._lock:
+        assert sched.records["urgent-train"].status == "queued"
+        assert sched.records[jid].status == "running"
+    assert "fleet.preempt" not in [
+        e["event"] for e in read_fleet_events(d)]
+    pool.drain(jid)   # the durable sentinel: replica finishes and exits
+    t.join(30)
+    assert not t.is_alive() and box["rc"] == EXIT_CLEAN
+    assert read_record(d, jid).status == "done"
+    assert read_record(d, "urgent-train").status == "done"
+
+
+# -- run_queue_loop on the FakeEngine -----------------------------------------
+
+class FakeEngine:
+    """Host-only engine double (the test_serving_resilience shape): the
+    scheduler surface with no XLA behind it."""
+
+    def __init__(self, max_batch=2, block_size=4, num_blocks=9,
+                 max_context=64):
+        self.max_batch = max_batch
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.max_context = max_context
+        self.max_blocks_per_seq = blocks_for(max_context, block_size)
+        self.quant_stats = None
+        self.decode_impl = "fallback"
+
+    @property
+    def quantized(self):
+        return False
+
+    def prefill(self, row, tokens, temperature=0.0, rid=0, prefix_len=0):
+        return 7, None
+
+    def decode(self, tables, lengths, tokens, temps, rids):
+        return np.full((self.max_batch,), 5, np.int32), None
+
+
+def _entry(rid, new=4, **kw):
+    return {"rid": rid, "prompt": [1, 2, 3], "max_new_tokens": new, **kw}
+
+
+def test_run_queue_loop_serves_entries_with_queue_wait(tmp_path):
+    q = str(tmp_path / "queue.jsonl")
+    append_queue(q, [_entry(0, enq_wall=time.time() - 0.2), _entry(1)])
+    request_drain(q)
+    terminal = []
+    results, wall = run_queue_loop(
+        Scheduler(FakeEngine()), q, poll_s=0.001,
+        on_terminal=lambda req, **ex: terminal.append((req, ex)))
+    assert set(results) == {0, 1}
+    assert all(r.state == "done" for r in results.values())
+    by_rid = {req.rid: ex for req, ex in terminal}
+    # rid 0 carried an enqueue stamp from 200ms ago: the dwell surfaces
+    assert by_rid[0]["queue_wait_ms"] >= 150.0
+    # rid 1 had no stamp: no fabricated queue_wait
+    assert "queue_wait_ms" not in by_rid[1]
+
+
+def test_run_queue_loop_restart_dedup_skips_answered(tmp_path):
+    q = str(tmp_path / "queue.jsonl")
+    append_queue(q, [_entry(0), _entry(1), _entry(2)])
+    request_drain(q)
+    results, _ = run_queue_loop(Scheduler(FakeEngine()), q,
+                                poll_s=0.001, answered={0, 2})
+    assert set(results) == {1}  # the previous attempt's answers skipped
+
+
+def test_run_queue_loop_picks_up_late_arrivals_then_drains(tmp_path):
+    q = str(tmp_path / "queue.jsonl")
+    append_queue(q, [_entry(0)])
+    box = {}
+
+    def run():
+        box["out"] = run_queue_loop(Scheduler(FakeEngine()), q,
+                                    poll_s=0.001)
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.15)
+    append_queue(q, [_entry(1)])   # late arrival while the loop idles
+    time.sleep(0.15)
+    request_drain(q)
+    t.join(20)
+    assert not t.is_alive(), "queue loop never drained"
+    results, _ = box["out"]
+    assert set(results) == {0, 1}
+    assert all(r.state == "done" for r in results.values())
+
+
+def test_run_queue_loop_sigterm_drain_sheds_as_give_back(tmp_path):
+    """The SIGTERM path: queued-but-unserved entries shed with reason
+    "draining" — the give-back record the router redistributes — while
+    in-flight work finishes within the drain budget."""
+    q = str(tmp_path / "queue.jsonl")
+    # max_batch=1: rid 0 occupies the slot, 1 and 2 wait in the queue
+    append_queue(q, [_entry(0, new=64), _entry(1), _entry(2)])
+    flag = threading.Event()
+    sched = Scheduler(FakeEngine(max_batch=1, num_blocks=40,
+                                 max_context=128))
+    stepped = []
+
+    def trip(_s):
+        stepped.append(1)
+        if len(stepped) == 3:
+            flag.set()
+
+    results, _ = run_queue_loop(sched, q, poll_s=0.001,
+                                drain=flag.is_set, drain_s=10.0,
+                                between_steps=trip)
+    assert set(results) == {0, 1, 2}
+    assert results[0].state == "done"          # in-flight: finished
+    assert results[1].state == "shed"
+    assert results[1].reason == "draining"     # the give-back marker
+    assert results[2].state == "shed"
+
+
+def test_scheduler_snapshot_shape_and_queue_loop_publishing(tmp_path):
+    q = str(tmp_path / "queue.jsonl")
+    snap_path = str(tmp_path / "SERVE_SNAPSHOT.json")
+    append_queue(q, [_entry(0), _entry(1)])
+    request_drain(q)
+    run_queue_loop(Scheduler(FakeEngine()), q, poll_s=0.001,
+                   snapshot=SnapshotPublisher(snap_path, every_steps=1))
+    snap = read_snapshot(snap_path)
+    for key in ("updated", "backlog_tokens", "queue_len", "n_active",
+                "token_rate", "decode_steps", "n_done", "n_expired",
+                "n_shed", "n_failed", "draining", "prefix_hit_rate"):
+        assert key in snap, key
+    assert snap["n_done"] == 2 and snap["backlog_tokens"] == 0
+    assert snap["draining"] is False
+
+
+# -- the Router against hand-written replica dirs -----------------------------
+
+def _mini_pool(tmp_path, n=2, **cfg):
+    """A pool over a scheduler that is never run: jobs stay 'queued'
+    (dispatchable — the durable queue IS the contract), and tests write
+    REQUESTS.jsonl / snapshots into the job dirs by hand."""
+    sched = FleetScheduler(str(tmp_path / "fleet"), 8, telemetry=False)
+    pool = ReplicaPool(sched, _serving_fake_spec(**cfg))
+    router = Router(pool, balancer=Balancer(),
+                    policy=None, default_rate=100.0)
+    for _ in range(n):
+        pool.spawn()
+    return sched, pool, router
+
+
+def _answer(pool, jid, rid, state="done", reason=None, **extra):
+    with open(pool.requests_log(jid), "a") as f:
+        f.write(json.dumps({"rid": rid, "state": state, "reason": reason,
+                            "n_generated": 4, **extra}) + "\n")
+
+
+def test_router_submit_dispatches_to_durable_queue(tmp_path):
+    _, pool, router = _mini_pool(tmp_path)
+    jid = router.submit(_entry(0, new=8))
+    assert jid in pool.replicas
+    recs, _ = read_jsonl_since(pool.queue_path(jid), 0)
+    assert recs[0]["rid"] == 0 and "enq_wall" in recs[0]
+    assert router.n_requests == 1
+    assert router.owed_tokens(jid) == 8
+    # balancing: the next request goes to the OTHER (idle) replica
+    jid2 = router.submit(_entry(1, new=8))
+    assert jid2 != jid
+    # conversation affinity: convo 5 sticks to its first replica
+    first = router.submit(_entry(2, convo=5), convo=5)
+    assert router.submit(_entry(3, convo=5), convo=5) == first
+
+
+def test_router_poll_exactly_once_with_duplicate_audit(tmp_path):
+    _, pool, router = _mini_pool(tmp_path)
+    a, b = pool.replicas
+    router.submit(_entry(0))
+    router.entries[0], router.assigned[0] = router.entries[0], a
+    _answer(pool, a, 0, ttft_ms=5.0, queue_wait_ms=10.0)
+    assert router.poll() == 1
+    assert router.results[0]["replica"] == a
+    # the same rid answered AGAIN (slow-not-dead double serve): audited,
+    # never double-counted
+    _answer(pool, b, 0)
+    assert router.poll() == 0
+    assert router.n_duplicates == 1
+    assert router.results[0]["replica"] == a   # first record won
+    # router-visible TTFT = queue wait + replica ttft
+    assert router.ttft_ms == [pytest.approx(15.0)]
+    # foreign rids in a REQUESTS.jsonl (not this router's traffic) skip
+    _answer(pool, a, 999)
+    assert router.poll() == 0 and 999 not in router.results
+
+
+def test_router_drain_give_back_redistributes(tmp_path):
+    _, pool, router = _mini_pool(tmp_path)
+    a, b = pool.replicas
+    rid_jid = router.submit(_entry(0))
+    other = b if rid_jid == a else a
+    # the replica drained with rid 0 still queued: the shed give-back
+    _answer(pool, rid_jid, 0, state="shed", reason="draining")
+    router.poll()
+    assert 0 not in router.results             # NOT a terminal answer
+    assert router.assigned[0] == other         # moved to the survivor
+    assert router.n_redistributed == 1
+    recs, _ = read_jsonl_since(pool.queue_path(other), 0)
+    assert recs[-1]["rid"] == 0
+    # a real (non-drain) shed IS terminal — load shedding is an answer
+    _answer(pool, other, 0, state="shed", reason="deadline infeasible")
+    router.poll()
+    assert router.results[0]["state"] == "shed"
+
+
+def test_router_absorbs_dead_replica_and_retries_until_backfill(tmp_path):
+    sched, pool, router = _mini_pool(tmp_path)
+    a, b = pool.replicas
+    router.submit(_entry(0))
+    router.submit(_entry(1))
+    # force both rids onto replica a, then kill a AND b (whole pool down)
+    for rid in (0, 1):
+        router.assigned[rid] = a
+    with sched._lock:
+        sched.records[a].status = "failed"
+        sched.records[b].status = "failed"
+    moved = router.absorb_dead()
+    assert moved == 0                          # no survivor yet: owed
+    assert router.unanswered(a) == [0, 1]
+    # the floor backfill spawns a replacement, the next tick moves them
+    router.policy = AutoscalePolicy(AutoscaleConfig(min_replicas=1,
+                                                    max_replicas=2))
+    assert router.scale_tick() == "up"         # backfill below the floor
+    assert router.absorb_dead() == 2
+    c = router.assigned[0]
+    assert c not in (a, b)
+    recs, _ = read_jsonl_since(pool.queue_path(c), 0)
+    assert {r["rid"] for r in recs} == {0, 1}
+    assert router.n_redistributed == 2
+    rep = router.report(wall_s=1.0)
+    assert rep["replicas_dead"] == 2 and rep["replicas_spawned"] == 3
+
+
+def test_router_pressure_prefers_measured_rates(tmp_path):
+    _, pool, router = _mini_pool(tmp_path)
+    a, b = pool.replicas
+    router.submit(_entry(0, new=100))
+    router.submit(_entry(1, new=100))
+    # no snapshots yet: default_rate=100 per replica -> 200/200 = 1.0s
+    assert router.pool_pressure_s() == pytest.approx(1.0)
+    publish_snapshot(os.path.join(pool.jdir(a), "SERVE_SNAPSHOT.json"),
+                     {"token_rate": 700.0, "backlog_tokens": 0})
+    publish_snapshot(os.path.join(pool.jdir(b), "SERVE_SNAPSHOT.json"),
+                     {"token_rate": 100.0, "backlog_tokens": 0})
+    assert router.pool_pressure_s() == pytest.approx(200.0 / 800.0)
+
+
+def test_router_report_exactly_once_audit(tmp_path):
+    _, pool, router = _mini_pool(tmp_path)
+    router.submit(_entry(0))
+    router.submit(_entry(1))
+    _answer(pool, router.assigned[0], 0, ttft_ms=4.0, queue_wait_ms=6.0)
+    router.tick()
+    rep = router.report(wall_s=2.0)
+    assert rep["requests"] == 2 and rep["answered"] == 1
+    assert rep["exactly_once"] is False        # rid 1 still owed
+    _answer(pool, router.assigned[1], 1, ttft_ms=4.0, queue_wait_ms=6.0)
+    router.tick()
+    rep = router.report(wall_s=2.0)
+    assert rep["exactly_once"] is True
+    assert rep["terminal_states"] == {"done": 2}
+    assert rep["generated_tokens"] == 8 and rep["value"] == 4.0
+    assert rep["ttft_ms"]["p50"] == pytest.approx(10.0)
+    assert rep["max_attempts"] == 1
+    assert rep["replica_trajectory"][0][1] == 2
+
+
+def test_router_drain_all_sentinels_every_live_replica(tmp_path):
+    _, pool, router = _mini_pool(tmp_path)
+    router.drain_all()
+    for jid in pool.replicas:
+        recs, _ = read_jsonl_since(pool.queue_path(jid), 0)
+        assert {"op": DRAIN_OP} in recs
+        assert jid in pool.draining
+    assert router._candidates() == []          # draining: undispatchable
+    # idempotent: a second drain_all appends no second sentinel
+    sizes = [os.path.getsize(pool.queue_path(j)) for j in pool.replicas]
+    router.drain_all()
+    assert sizes == [os.path.getsize(pool.queue_path(j))
+                     for j in pool.replicas]
+
+
+def test_router_telemetry_uses_registered_names_only(tmp_path):
+    """Every event the router emits flows through the ISSUE 13 registry:
+    drive the dispatch/duplicate/death/redistribute/scale paths with a
+    real Telemetry and check each emitted name is registered."""
+    from theanompi_tpu.telemetry import Telemetry
+    from theanompi_tpu.telemetry.metrics import (
+        ROUTER_COUNTERS,
+        ROUTER_GAUGES,
+        ROUTER_INSTANTS,
+    )
+
+    assert set(ROUTER_INSTANTS) == {
+        "router.dispatch", "router.redistribute", "router.replica_dead",
+        "router.scale_up", "router.scale_down", "router.duplicate"}
+    assert set(ROUTER_GAUGES) == {
+        "router.replicas", "router.backlog_tokens", "router.ttft_p99_ms"}
+    assert set(ROUTER_COUNTERS) == {
+        "router.requests", "router.redistributed"}
+
+    sched, pool, router = _mini_pool(tmp_path)
+    tel_dir = str(tmp_path / "tel")
+    router.telemetry = Telemetry(tel_dir, rank=0)
+    router.policy = AutoscalePolicy(AutoscaleConfig(min_replicas=2,
+                                                    max_replicas=3))
+    a, b = pool.replicas
+    router.submit(_entry(0))
+    router.submit(_entry(1))
+    _answer(pool, router.assigned[0], 0, ttft_ms=1.0)
+    _answer(pool, b if router.assigned[0] == a else a, 0)  # duplicate
+    _answer(pool, router.assigned[1], 1, state="shed", reason="draining")
+    router.tick()
+    with sched._lock:
+        sched.records[a].status = "failed"
+    router.tick()                              # death + backfill
+    router.telemetry.flush_metrics()
+    router.telemetry.close()
+    registered = (set(ROUTER_INSTANTS) | set(ROUTER_GAUGES)
+                  | set(ROUTER_COUNTERS))
+    seen = set()
+    for fname in os.listdir(tel_dir):
+        if not fname.startswith("events-rank"):
+            continue
+        for line in open(os.path.join(tel_dir, fname)):
+            ev = json.loads(line)
+            if ev.get("name", "").startswith("router."):
+                seen.add(ev["name"])
+    assert seen <= registered
+    assert "router.dispatch" in seen and "router.replica_dead" in seen
+    assert "router.duplicate" in seen and "router.redistribute" in seen
+
+
+# -- the tmrouter CLI surface -------------------------------------------------
+
+def test_parse_set_literal_grammar():
+    out = router_cli._parse_set(["dim=64", "precision='fp32'", "name=raw"])
+    assert out == {"dim": 64, "precision": "fp32", "name": "raw"}
+    with pytest.raises(ValueError, match="K=V"):
+        router_cli._parse_set(["oops"])
+
+
+def test_synthetic_entries_turn_grammar_and_arrivals():
+    entries = router_cli.synthetic_entries(6, vocab=64, prompt_len=4,
+                                           max_new_tokens=8, rate=0.0,
+                                           seed=0, turns=3)
+    assert [e["rid"] for e in entries] == list(range(6))
+    assert all(e["arrival_s"] == 0.0 for e in entries)   # burst
+    assert [e["convo"] for e in entries] == [0, 0, 0, 1, 1, 1]
+    # within a conversation, each turn EXTENDS the previous prompt — the
+    # prefix-affinity traffic shape
+    assert entries[1]["prompt"][:4] == entries[0]["prompt"]
+    assert entries[2]["prompt"][:8] == entries[1]["prompt"]
+    # a new conversation starts fresh
+    assert len(entries[3]["prompt"]) == 4
+    # seeded determinism + Poisson arrivals strictly increase
+    again = router_cli.synthetic_entries(6, 64, 4, 8, 0.0, 0, turns=3)
+    assert again == entries
+    timed = router_cli.synthetic_entries(5, 64, 4, 8, rate=100.0, seed=1)
+    arr = [e["arrival_s"] for e in timed]
+    assert arr == sorted(arr) and arr[0] > 0 and timed[0]["convo"] is None
+
+
+def test_router_cli_parser_defaults_and_script():
+    args = router_cli.build_parser().parse_args(["--fleet-dir", "/x"])
+    assert args.replicas == 1 and args.max_replicas == 4
+    assert args.replica_priority == 10 and not args.no_autoscale
+    # the console script is wired (same contract as tmserve/tmfleet)
+    with open(os.path.join(REPO, "pyproject.toml")) as f:
+        assert 'tmrouter = "theanompi_tpu.router.cli:main"' in f.read()
+
+
+# -- perf-ledger classification -----------------------------------------------
+
+def test_ledger_classifies_router_artifact():
+    from theanompi_tpu.telemetry.ledger import classify_artifact
+
+    recs = classify_artifact("ROUTER.json", {
+        "metric": "router_tokens_per_sec", "value": 123.4,
+        "ttft_ms": {"p50": 10.0, "p99": 40.0}, "replicas_peak": 3,
+        "run_id": "r1"})
+    by_metric = {r["metric"]: r for r in recs}
+    assert by_metric["router.tokens_per_sec"]["value"] == 123.4
+    assert by_metric["router.ttft_p99_ms"]["value"] == 40.0
+    assert by_metric["router.ttft_p50_ms"]["value"] == 10.0
+    assert by_metric["router.replicas_peak"]["value"] == 3
+    assert all(r["kind"] == "router" for r in recs)
+    # and the generic bench-line branch did NOT swallow it
+    assert "router_tokens_per_sec" not in by_metric

@@ -332,6 +332,69 @@ def test_runbook_serve_decode_kernel_ab(tmp_path):
     assert impls["on"] == "kernel_interpret"  # CPU host: interpreter
 
 
+def test_runbook_router_command(tmp_path, monkeypatch, capsys):
+    """BASELINE step 6e (ISSUE 19): the exact `tmrouter` invocation at
+    toy scale — two REAL tmserve replicas leased as ``kind="serving"``
+    fleet jobs on the mesh8 pool, the seeded open-loop trace balanced
+    over their durable queues, and the ROUTER.json fields the step's
+    procedure reads (exactly_once, router-visible ttft_ms percentiles,
+    replica_trajectory, fleet_exit).  The contention/autoscale half is
+    locked at full depth in test_router_e2e.py; replicas here inherit
+    the session compile cache through the fleet child env."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("PYTHONPATH",
+                       repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    monkeypatch.delenv("THEANOMPI_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("THEANOMPI_DATA_TRACE", raising=False)
+    from theanompi_tpu.router import cli as router_cli
+
+    d = str(tmp_path / "fleet")
+    out = str(tmp_path / "ROUTER.json")
+    tel = str(tmp_path / "telemetry-router")
+    # same tiny shapes as the other step-6 dry-runs: the replica
+    # subprocesses hit the session compile cache those tests warmed
+    rc = router_cli.main([
+        "--fleet-dir", d, "--pool-size", "8",
+        "--replicas", "2", "--max-replicas", "2", "--replica-devices", "2",
+        "--modelclass", "TransformerLM",
+        "--set", "dim=32", "--set", "heads=2", "--set", "n_layers=1",
+        "--set", "seq_len=32", "--set", "vocab=61", "--set", "dropout=0.0",
+        "--set", "precision='fp32'", "--set", "n_train=64",
+        "--set", "n_val=32",
+        "--replica-arg=--max-batch", "--replica-arg=2",
+        "--replica-arg=--block-size", "--replica-arg=4",
+        "--requests", "4", "--vocab", "61", "--prompt-len", "4",
+        "--max-new-tokens", "4", "--timeout-s", "120",
+        "--telemetry-dir", tel, "--out", out, "--quiet",
+    ])
+    assert rc == 0
+    art = json.load(open(out))
+    # the fields step 6e's procedure reads
+    assert art["exactly_once"] is True
+    assert art["requests"] == 4 and art["answered"] == 4
+    assert art["terminal_states"] == {"done": 4}
+    assert art["metric"] == "router_tokens_per_sec" and art["value"] > 0
+    assert "p50" in art["ttft_ms"] and "p99" in art["ttft_ms"]
+    assert art["replicas_spawned"] == 2 and art["replicas_dead"] == 0
+    assert art["fleet_exit"] == 0
+    assert art["replica_trajectory"][-1][1] == 2
+    # one-JSON-line stdout (bench contract)
+    line = [l for l in capsys.readouterr().out.splitlines()
+            if l.startswith("{")][-1]
+    assert json.loads(line)["metric"] == "router_tokens_per_sec"
+    # router.* telemetry flowed through the registered names
+    ev_files = [f for f in os.listdir(tel) if f.startswith("events-rank")]
+    assert ev_files
+    body = open(os.path.join(tel, ev_files[0])).read()
+    assert "router.dispatch" in body
+    # every lease returned: both replica jobs drained to done
+    from theanompi_tpu.fleet import read_record
+
+    for jid in ("replica-0", "replica-1"):
+        assert read_record(d, jid).status == "done"
+
+
 def test_runbook_serve_resilience_command(tmp_path):
     """RUNBOOK step 6b (ISSUE 14): the resilient-serving flags of the
     exact invocation — deadlines + --shed, --drain-s, --rollout-watch —

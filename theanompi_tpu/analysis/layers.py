@@ -57,6 +57,11 @@ LAYER_DAG: tuple[tuple[str, tuple[str, ...], tuple[str, ...]], ...] = (
     # the analysis layer above
     ("syncpoint",  (f"{PKG}.analysis.interleave",), ()),
     ("codes",      (f"{PKG}.resilience.codes",), ()),
+    # the durable serving file contracts (queue.jsonl / REQUESTS.jsonl /
+    # SERVE_SNAPSHOT.json, ISSUE 19) are stdlib-only — a bottom layer,
+    # peeled off ``serving`` by longest-prefix so the ROUTER may speak
+    # the wire format without importing the engine/scheduler machinery
+    ("serve_lifecycle", (f"{PKG}.serving.lifecycle",), ()),
     ("native",     (f"{PKG}.native",), ()),
     ("telemetry",  (f"{PKG}.telemetry",), ("syncpoint",)),
     ("resilience", (f"{PKG}.resilience",), ("codes", "telemetry")),
@@ -97,6 +102,13 @@ LAYER_DAG: tuple[tuple[str, tuple[str, ...], tuple[str, ...]], ...] = (
     ("fleet",      (f"{PKG}.fleet",),
                    ("syncpoint", "codes", "telemetry", "resilience",
                     "utils_base")),
+    # the router composes serving REPLICAS as fleet jobs (ISSUE 19): its
+    # world is the fleet scheduler, the durable lifecycle file contracts,
+    # exit codes and telemetry — the serving engine/scheduler machinery
+    # and training both stay subprocesses (any-depth wall below)
+    ("router",     (f"{PKG}.router",),
+                   ("syncpoint", "codes", "serve_lifecycle", "telemetry",
+                    "resilience", "utils_base", "fleet")),
     # serving is a read-only consumer: kernels (shared int8 wire format),
     # verified checkpoint loads, telemetry, the launcher's config surface
     # — NEVER exchange/training (see the any-depth wall below).
@@ -104,14 +116,14 @@ LAYER_DAG: tuple[tuple[str, tuple[str, ...], tuple[str, ...]], ...] = (
     # codes only; the supervisor/sentinel/watchdog machinery stays
     # walled off any-depth below
     ("serving",    (f"{PKG}.serving",),
-                   ("codes", "telemetry", "kernels", "utils_base", "ckpt",
-                    "tooling", "resilience")),
+                   ("codes", "serve_lifecycle", "telemetry", "kernels",
+                    "utils_base", "ckpt", "tooling", "resilience")),
     ("analysis",   (f"{PKG}.analysis",),
-                   ("syncpoint", "codes", "native", "telemetry",
-                    "resilience", "mesh",
+                   ("syncpoint", "codes", "serve_lifecycle", "native",
+                    "telemetry", "resilience", "mesh",
                     "kernels", "sharding", "ops", "utils_base", "exchange",
                     "data", "models", "ckpt", "training", "tooling",
-                    "fleet", "serving")),
+                    "fleet", "router", "serving")),
 )
 
 #: training-side modules serving must never import at ANY depth (PR 6's
@@ -139,6 +151,10 @@ SERVING_FORBIDDEN_IMPORTS = (
     # scheduler that may be preempting it — coordination flows the other
     # way, through processes and exit codes
     f"{PKG}.fleet",
+    # serving ⊥ router (ISSUE 19), same shape: a replica must not reach
+    # into the router that balances/drains it — it reads queue.jsonl and
+    # writes REQUESTS.jsonl/SERVE_SNAPSHOT.json, nothing more
+    f"{PKG}.router",
 )
 
 #: the mirror half of the serving ⊥ fleet wall, any depth: the scheduler
@@ -151,6 +167,36 @@ FLEET_FORBIDDEN_IMPORTS = (
     f"{PKG}.models",
     f"{PKG}.ops",
     f"{PKG}.launcher",
+    # fleet ⊥ router (ISSUE 19): the scheduler does not know replicas
+    # exist — the router submits serving JobSpecs downward, never the
+    # reverse
+    f"{PKG}.router",
+)
+
+#: the router's world (ISSUE 19) is fleet jobs + the durable lifecycle
+#: file contracts + telemetry/codes: the serving engine/scheduler
+#: machinery and the training stack always run in replica/training
+#: SUBPROCESSES.  Any-depth, like the serving wall — a lazy engine
+#: import in the router would couple the balancing loop's lifetime to a
+#: jax runtime it exists to supervise.  ``serving.lifecycle`` is the one
+#: serving module the router may touch (the stdlib-only wire format);
+#: the supervisor machinery is reached only through the fleet layer's
+#: run_job seam, never directly.
+ROUTER_FORBIDDEN_IMPORTS = (
+    f"{PKG}.parallel",
+    f"{PKG}.models",
+    f"{PKG}.ops",
+    f"{PKG}.launcher",
+    f"{PKG}.serving.engine",
+    f"{PKG}.serving.scheduler",
+    f"{PKG}.serving.kv_cache",
+    f"{PKG}.serving.prefix_cache",
+    f"{PKG}.serving.rollout",
+    f"{PKG}.serving.quant",
+    f"{PKG}.serving.cli",
+    f"{PKG}.resilience.supervisor",
+    f"{PKG}.resilience.sentinel",
+    f"{PKG}.resilience.watchdog",
 )
 
 #: subpackages that must stay import leaves at ANY depth: everything
@@ -359,6 +405,14 @@ class ImportDagRule(Rule):
                         f"fleet imports {imp} — the scheduler supervises "
                         f"training/serving as subprocesses and must never "
                         f"import that machinery, even lazily")
+        if _under(mod, f"{PKG}.router"):
+            for lineno, imp in _all_imports(src.tree):
+                if any(_under(imp, bad) for bad in ROUTER_FORBIDDEN_IMPORTS):
+                    yield self.finding(
+                        src, lineno, 0,
+                        f"router imports {imp} — replicas and training are "
+                        f"subprocesses; the router speaks only the durable "
+                        f"lifecycle file contracts and the fleet job seam")
         for leaf, ok_prefixes in LEAF_SUBPACKAGES.items():
             if not _under(mod, leaf):
                 continue

@@ -111,6 +111,7 @@ SWALLOW_ALLOWLIST = {
     ("theanompi_tpu/serving/cli.py", "main"),        # tmserve contract
     ("theanompi_tpu/analysis/cli.py", "main"),       # tmlint contract
     ("theanompi_tpu/fleet/cli.py", "main"),          # tmfleet contract
+    ("theanompi_tpu/router/cli.py", "main"),         # tmrouter contract
 }
 
 _BROAD = {"Exception", "BaseException"}
@@ -746,6 +747,9 @@ class DataDeterminismRule(Rule):
 REGISTERED_NAME_PREFIXES = (
     "theanompi_tpu/serving/",
     "theanompi_tpu/resilience/",
+    # ISSUE 19: the router's dispatch/redistribute/scale decisions feed
+    # the same consumers — its router.* names are registered too
+    "theanompi_tpu/router/",
     # ISSUE 16: the attribution/ledger emitters live by the same contract
     # (their attr.*/prof.*/ledger.* names are registered in metrics.py)
     "theanompi_tpu/telemetry/profile.py",

@@ -48,6 +48,12 @@ class JobSpec:
     priority: int = 0
     min_devices: int = 1            #: gang size floor (all-or-nothing)
     max_devices: int | None = None  #: cap; None = take whatever is free
+    #: "training" (a tmlauncher child — preemptible, resumes elastically)
+    #: or "serving" (a tmserve replica driven off a durable queue file,
+    #: ISSUE 19 — never a preemption victim: replicas leave through the
+    #: router's drain, and a SIGTERM-drained replica exiting 0 is DONE,
+    #: not requeued)
+    kind: str = "training"
     rule: str = "BSP"
     modelfile: str = "theanompi_tpu.models.wide_resnet"
     modelclass: str = "WideResNet"
@@ -76,6 +82,10 @@ class JobSpec:
             raise JobSpecError(
                 f"job {self.job_id!r}: max_devices {self.max_devices} < "
                 f"min_devices {self.min_devices}")
+        if self.kind not in ("training", "serving"):
+            raise JobSpecError(
+                f"job {self.job_id!r}: unknown kind {self.kind!r} "
+                f"(training | serving)")
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -162,9 +172,28 @@ def build_child_cmd(spec: JobSpec, devices: int, jdir: str, *,
     after a preemption: ``--resume --resume-reshard`` replans the
     cadence checkpoint onto the new device count, and the sample cursor
     (PR 9) fast-forwards the data stream — nothing replayed or skipped
-    across the shrink."""
+    across the shrink.
+
+    ``kind="serving"`` (ISSUE 19) builds a ``tmserve --queue-file`` child
+    instead: the replica tails ``<jdir>/queue.jsonl`` for router-appended
+    requests and logs terminal states to ``<jdir>/REQUESTS.jsonl``.
+    ``resume`` is meaningless for a replica — restart continuity is the
+    REQUESTS.jsonl dedup, not a checkpoint (both command strings are just
+    module names; fleet never imports launcher or serving)."""
     if spec.argv is not None:
         return list(spec.argv)
+    if spec.kind == "serving":
+        cmd = [sys.executable, "-m", "theanompi_tpu.serving",
+               "--modelfile", spec.modelfile,
+               "--modelclass", spec.modelclass]
+        for k, v in spec.model_config.items():
+            cmd += ["--set", f"{k}={v!r}"]
+        cmd += ["--queue-file", os.path.join(jdir, "queue.jsonl"),
+                "--requests-log", os.path.join(jdir, "REQUESTS.jsonl"),
+                "--telemetry-dir", os.path.join(jdir, "telemetry"),
+                "--quiet"]
+        cmd += [str(a) for a in spec.extra_args]
+        return cmd
     cmd = [sys.executable, "-m", "theanompi_tpu.launcher",
            "--rule", spec.rule, "--devices", str(int(devices)),
            "--modelfile", spec.modelfile, "--modelclass", spec.modelclass]

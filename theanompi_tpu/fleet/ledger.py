@@ -31,6 +31,15 @@ class LedgerError(RuntimeError):
     with no recoverable generation)."""
 
 
+#: failure-history bounds (ISSUE 19 satellite): a router restarting
+#: replicas for days must not grow ledger.json without bound, so the
+#: ledger keeps the last FAILURES_PER_JOB causes per job across the
+#: FAILURES_JOBS most-recently-failing jobs, with dropped-count
+#: witnesses for everything evicted
+FAILURES_PER_JOB = 3
+FAILURES_JOBS = 32
+
+
 class DeviceLedger:
     """Device inventory with per-job leases and crash-safe persistence.
 
@@ -52,9 +61,17 @@ class DeviceLedger:
             self.pool_size = int(state["pool_size"])
             self.leases = {str(k): int(v)
                            for k, v in state["leases"].items()}
-            # optional (ISSUE 13) — a pre-13 ledger has no failures map
-            self.failures = {str(k): v for k, v
-                             in (state.get("failures") or {}).items()}
+            # optional (ISSUE 13) — a pre-13 ledger has no failures map;
+            # pre-19 entries were one bare cause dict per job — wrap them
+            # into the bounded shape ({"causes": [...], "dropped", "seq"})
+            self.failures = {
+                str(k): (v if isinstance(v, dict) and "causes" in v
+                         else {"causes": [v], "dropped": 0, "seq": 0})
+                for k, v in (state.get("failures") or {}).items()}
+            self.failures_dropped = int(state.get("failures_dropped", 0))
+            self._fail_seq = 1 + max(
+                (int(v.get("seq", 0)) for v in self.failures.values()),
+                default=-1)
             if pool_size is not None and int(pool_size) != self.pool_size:
                 raise LedgerError(
                     f"--pool-size {pool_size} conflicts with the persisted "
@@ -70,6 +87,8 @@ class DeviceLedger:
             self.pool_size = int(pool_size)
             self.leases: dict[str, int] = {}
             self.failures: dict[str, dict] = {}
+            self.failures_dropped = 0
+            self._fail_seq = 0
             self.persist()
 
     # -- leases --------------------------------------------------------------
@@ -109,9 +128,36 @@ class DeviceLedger:
     def record_failure(self, job_id: str, cause: dict) -> None:
         """Persist ``job_id``'s failure cause (ISSUE 13): the supervisor
         classification plus the blackbox summary the dead child left, so
-        ``tmfleet status`` of a long-gone job still answers *why*."""
-        self.failures[str(job_id)] = dict(cause)
+        ``tmfleet status`` of a long-gone job still answers *why*.
+
+        Bounded (ISSUE 19 satellite): each job keeps its last
+        ``FAILURES_PER_JOB`` causes with a per-job ``dropped`` count, and
+        only the ``FAILURES_JOBS`` most-recently-failing jobs stay in the
+        map at all (``failures_dropped`` witnesses whole-job evictions) —
+        a crash-looping replica restarted for days cannot grow
+        ledger.json without bound."""
+        entry = self.failures.setdefault(
+            str(job_id), {"causes": [], "dropped": 0, "seq": 0})
+        entry["causes"].append(dict(cause))
+        if len(entry["causes"]) > FAILURES_PER_JOB:
+            entry["dropped"] += len(entry["causes"]) - FAILURES_PER_JOB
+            entry["causes"] = entry["causes"][-FAILURES_PER_JOB:]
+        entry["seq"] = self._fail_seq
+        self._fail_seq += 1
+        while len(self.failures) > FAILURES_JOBS:
+            oldest = min(self.failures,
+                         key=lambda k: int(self.failures[k].get("seq", 0)))
+            self.failures.pop(oldest)
+            self.failures_dropped += 1
         self.persist()
+
+    def last_failure(self, job_id: str) -> dict | None:
+        """The most recent recorded cause for ``job_id`` (None when its
+        history was never recorded or has been evicted)."""
+        entry = self.failures.get(str(job_id))
+        if not entry or not entry.get("causes"):
+            return None
+        return entry["causes"][-1]
 
     # -- crash-safe persistence ----------------------------------------------
     def persist(self) -> None:
@@ -120,6 +166,8 @@ class DeviceLedger:
                 "generation": self._persists}
         if self.failures:
             data["failures"] = dict(sorted(self.failures.items()))
+        if self.failures_dropped:
+            data["failures_dropped"] = self.failures_dropped
         with open(self.path + ".tmp", "w") as f:
             json.dump(data, f, indent=1)
         if os.path.exists(self.path):

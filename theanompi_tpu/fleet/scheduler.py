@@ -94,6 +94,9 @@ class FleetScheduler:
         self._launches = 0
         self._episode_wall: dict[str, float] = {}  #: launch wall time
         self._hung_flagged: set[str] = set()
+        #: jobs asked to drain before their episode thread registered a
+        #: Supervisor (the on_sup race — mirrors the "preempting" check)
+        self._draining: set[str] = set()
         self._next_health_s = 0.0
         self._telemetry = None
         self._telemetry_enabled = bool(telemetry)
@@ -245,7 +248,12 @@ class FleetScheduler:
             victims = sorted(
                 (r for r in self.records.values()
                  if r.status == "running"
-                 and r.spec.priority < spec.priority),
+                 and r.spec.priority < spec.priority
+                 # only TRAINING yields to priority (ISSUE 19): it
+                 # checkpoints and resumes elastically; a serving replica
+                 # holds live traffic and leaves only through the
+                 # router's drain (drain_job), never a forced preemption
+                 and r.spec.kind == "training"),
                 key=lambda r: (r.spec.priority, r.spec.job_id))
             avail = self.ledger.free + pending
             for victim in victims:
@@ -326,6 +334,26 @@ class FleetScheduler:
         # its on_supervisor callback sees status == "preempting" and
         # terminates immediately (no lost preemption).
 
+    def drain_job(self, job_id: str) -> bool:
+        """Ask a running serving replica to drain and exit clean (the
+        router's scale-down path, ISSUE 19): SIGTERM through its
+        supervisor — the replica stops admitting, finishes in-flight
+        work within its ``--drain-s``, exits 0 and the episode
+        classifies DONE (lease released, chips back in the pool).  ->
+        whether a running job was signalled."""
+        with self._lock:
+            rec = self.records.get(job_id)
+            if rec is None or rec.status != "running":
+                return False
+            self._event("fleet.drain", job=job_id)
+            self._draining.add(job_id)
+            sup = self._sups.get(job_id)
+            if sup is not None:
+                sup.terminate()
+            # else: the on_sup race — the episode thread's callback sees
+            # the _draining mark and terminates immediately
+            return True
+
     # -- one supervised episode (worker thread) -------------------------------
     def _episode(self, rec: JobRecord, n: int, resume: bool,
                  kill_child: bool) -> None:
@@ -341,7 +369,8 @@ class FleetScheduler:
         def on_sup(sup):
             with self._lock:
                 self._sups[jid] = sup
-                preempting = rec.status == "preempting"
+                preempting = (rec.status == "preempting"
+                              or jid in self._draining)
             if preempting:
                 sup.terminate()
             if kill_child:
@@ -349,21 +378,26 @@ class FleetScheduler:
                                  name=f"fleet-kill-{jid}",
                                  daemon=True).start()
 
+        serving = rec.spec.kind == "serving"
         result = run_job(
             cmd, on_supervisor=on_sup,
             max_restarts=rec.spec.max_restarts,
             backoff_base=rec.spec.backoff_base,
             resilience_path=os.path.join(jdir, "resilience.json"),
             telemetry_dir=os.path.join(jdir, "telemetry"),
-            env=env)
+            env=env,
+            # a restarted replica's continuity is REQUESTS.jsonl dedup,
+            # not a checkpoint — never append training resume flags
+            **({"resume_args": ()} if serving else {}))
         sp("fleet.episode.done")
         with self._lock:
             self.ledger.release(jid)
             self._episode_wall.pop(jid, None)
             self._hung_flagged.discard(jid)
+            self._draining.discard(jid)
             rec.devices = None
             rec.last_exit = result.exit_code
-            if result.preempted:
+            if result.preempted and not serving:
                 rec.status = "preempted"
                 rec.preemptions += 1
                 rec.preempt_exits.append(result.exit_code)

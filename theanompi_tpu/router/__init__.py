@@ -1,0 +1,29 @@
+"""Multi-replica serving router + autoscaler on the fleet ledger
+(ISSUE 19).
+
+Replicas are ``kind="serving"`` fleet jobs holding gang device leases;
+the router coordinates with them only through durable files
+(``queue.jsonl`` / ``REQUESTS.jsonl`` / ``SERVE_SNAPSHOT.json`` — see
+:mod:`theanompi_tpu.serving.lifecycle`), balances on live load with
+conversation affinity, absorbs replica death by redistributing
+unanswered rids, and scales the pool against the same ledger training
+uses — preempting strictly-lower-priority training on spikes and
+returning the chips on drain.
+
+The layer imports fleet + serving *lifecycle* + telemetry + codes only;
+serving engine/scheduler machinery and training are always subprocesses
+(the ``tmlint`` wall holds).
+"""
+
+from theanompi_tpu.router.autoscale import AutoscaleConfig, AutoscalePolicy
+from theanompi_tpu.router.balance import Balancer, est_wait_s
+from theanompi_tpu.router.pool import ReplicaPool, Router
+
+__all__ = [
+    "AutoscaleConfig",
+    "AutoscalePolicy",
+    "Balancer",
+    "ReplicaPool",
+    "Router",
+    "est_wait_s",
+]

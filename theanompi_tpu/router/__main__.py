@@ -1,0 +1,6 @@
+"""``python -m theanompi_tpu.router`` == the ``tmrouter`` console script."""
+
+from theanompi_tpu.router.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

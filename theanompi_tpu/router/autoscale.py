@@ -1,0 +1,112 @@
+"""Autoscale policy: grow on sustained pressure, shrink when it subsides.
+
+Pure decision logic (ISSUE 19): the router measures *pressure* — how
+many seconds of queued-but-unanswered work the pool is carrying at its
+current aggregate decode rate — and feeds it in each tick; the policy
+answers "up", "down", or None.  Everything stateful about ACTING on the
+decision (leasing chips from the fleet ledger, preempting training,
+draining a replica) lives in :mod:`theanompi_tpu.router.pool`; this
+module never touches a file or a process, and its clock is injectable,
+so the hysteresis windows are unit-testable in microseconds.
+
+Hysteresis, not thresholds: a single burst above the up-pressure line
+must not lease chips (scale-up preempts a training job — expensive and
+disruptive), and a single idle poll must not drain a replica that is
+about to receive the next burst.  Pressure must stay above
+``up_pressure_s`` for ``up_after_s`` continuous seconds (or the TTFT
+SLO must be breached, which is damage already happening and skips the
+wait) to scale up, and below ``down_pressure_s`` for ``down_after_s``
+to scale down; ``cooldown_s`` after any decision lets the pool's new
+shape actually absorb load before the next judgement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    min_replicas: int = 1
+    max_replicas: int = 4
+    #: scale up when queued work exceeds this many seconds at the pool's
+    #: current aggregate rate, sustained for ``up_after_s``
+    up_pressure_s: float = 4.0
+    up_after_s: float = 1.0
+    #: scale down when pressure stays below this for ``down_after_s``
+    down_pressure_s: float = 0.5
+    down_after_s: float = 2.0
+    #: no decisions for this long after the previous one
+    cooldown_s: float = 2.0
+    #: optional TTFT SLO (ms): a breached rolling p99 scales up without
+    #: waiting out ``up_after_s`` (the damage is already user-visible)
+    ttft_slo_ms: float | None = None
+
+    def validate(self) -> None:
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas < min_replicas")
+        if self.down_pressure_s >= self.up_pressure_s:
+            raise ValueError("down_pressure_s must be < up_pressure_s "
+                             "(hysteresis band would invert)")
+
+
+class AutoscalePolicy:
+    """Hysteresis state machine over the config above.  ``clock`` is any
+    zero-arg monotonic-seconds callable (injectable for tests)."""
+
+    def __init__(self, cfg: AutoscaleConfig | None = None, *,
+                 clock=time.monotonic):
+        self.cfg = cfg or AutoscaleConfig()
+        self.cfg.validate()
+        self._clock = clock
+        self._above_since: float | None = None
+        self._below_since: float | None = None
+        self._last_decision_t: float | None = None
+
+    def observe(self, n_replicas: int, pressure_s: float,
+                ttft_p99_ms: float | None = None) -> str | None:
+        """One tick: current live replica count + pool pressure (seconds
+        of queued work at the current rate) + optional rolling p99 TTFT.
+        -> "up" | "down" | None.  Bounds are enforced here: "up" is never
+        returned at ``max_replicas`` nor "down" at ``min_replicas``."""
+        now = self._clock()
+        cfg = self.cfg
+        # track the sustain windows even during cooldown, so a spike that
+        # began mid-cooldown has its duration credited at cooldown end
+        if pressure_s > cfg.up_pressure_s:
+            if self._above_since is None:
+                self._above_since = now
+            self._below_since = None
+        elif pressure_s < cfg.down_pressure_s:
+            if self._below_since is None:
+                self._below_since = now
+            self._above_since = None
+        else:  # inside the hysteresis band: sustain nothing
+            self._above_since = None
+            self._below_since = None
+        if (self._last_decision_t is not None
+                and now - self._last_decision_t < cfg.cooldown_s):
+            return None
+        slo_breached = (cfg.ttft_slo_ms is not None
+                        and ttft_p99_ms is not None
+                        and ttft_p99_ms > cfg.ttft_slo_ms)
+        if n_replicas < cfg.max_replicas and (
+                slo_breached
+                or (self._above_since is not None
+                    and now - self._above_since >= cfg.up_after_s)):
+            self._decide(now)
+            return "up"
+        if (n_replicas > cfg.min_replicas
+                and self._below_since is not None
+                and now - self._below_since >= cfg.down_after_s):
+            self._decide(now)
+            return "down"
+        return None
+
+    def _decide(self, now: float) -> None:
+        self._last_decision_t = now
+        self._above_since = None
+        self._below_since = None

@@ -1,0 +1,88 @@
+"""Replica selection: shortest-estimated-wait with conversation affinity.
+
+The balancer is deliberately pure host logic over numbers the router
+hands it — no file reads, no fleet calls — so every policy edge is unit
+testable without a replica process.
+
+Wait estimation blends two sources (ISSUE 19): the router's own exact
+ledger of tokens it has queued to a replica and not yet seen answered
+(always current, but blind to how fast the replica actually decodes),
+and the replica's live ``SERVE_SNAPSHOT.json`` (authoritative backlog +
+measured token rate, but a poll interval stale).  Taking the max of the
+two backlogs over the snapshot's measured rate is conservatively
+correct under both failure modes: a stale snapshot cannot hide work the
+router just queued, and a router that undercounts (requests submitted
+by someone else) is corrected by the replica's own number.
+
+Conversation affinity: multi-turn sessions re-send the conversation so
+far, which is exactly the traffic the ISSUE 17 radix prefix cache
+serves from cached K/V — but only on the replica that holds the blocks.
+The balancer therefore routes a conversation sticky to its previous
+replica until that replica's estimated wait exceeds
+``stick_factor x best + stick_slack_s`` (prefix-cache savings are
+bounded; unbounded stickiness would defeat load balancing).
+"""
+
+from __future__ import annotations
+
+
+def est_wait_s(owed_tokens: int, snap: dict | None,
+               default_rate: float = 50.0) -> float:
+    """Estimated seconds of work ahead of a new request on one replica.
+
+    ``owed_tokens``: the router's ledger of max-new-token budget queued
+    to the replica and not yet answered.  ``snap``: the replica's last
+    live snapshot (None until it publishes).  ``default_rate``: assumed
+    tokens/sec before the replica has measured one (cold start) — keeps
+    pressure finite so an autoscaler judging backlog/rate never divides
+    by an unmeasured zero.
+    """
+    backlog = max(0, int(owed_tokens))
+    rate = float(default_rate)
+    if snap:
+        backlog = max(backlog, int(snap.get("backlog_tokens") or 0))
+        measured = snap.get("token_rate")
+        if measured:
+            rate = float(measured)
+    return backlog / max(rate, 1e-6)
+
+
+class Balancer:
+    """Pick the replica with the shortest estimated wait, with sticky
+    conversation routing (see module docstring)."""
+
+    def __init__(self, stick_factor: float = 2.0,
+                 stick_slack_s: float = 0.5):
+        self.stick_factor = float(stick_factor)
+        self.stick_slack_s = float(stick_slack_s)
+        self._sticky: dict[int, str] = {}  #: convo -> replica job id
+
+    def choose(self, waits: dict[str, float],
+               convo: int | None = None) -> tuple[str, bool]:
+        """-> (replica job id, whether affinity kept a previous target).
+
+        ``waits``: candidate replica -> estimated wait seconds (already
+        filtered to live, non-draining replicas).  Ties break on job id
+        so the choice is deterministic under equal load.
+        """
+        if not waits:
+            raise ValueError("no candidate replicas")
+        best = min(waits, key=lambda j: (waits[j], j))
+        if convo is None:
+            return best, False
+        held = self._sticky.get(convo)
+        if (held is not None and held in waits and held != best
+                and waits[held] <= waits[best] * self.stick_factor
+                + self.stick_slack_s):
+            return held, True
+        self._sticky[convo] = best
+        return best, held == best
+
+    def forget_replica(self, jid: str) -> int:
+        """Drop every conversation pinned to a dead/draining replica (its
+        prefix blocks are gone — nothing left to be sticky to); -> how
+        many conversations were released."""
+        stale = [c for c, j in self._sticky.items() if j == jid]
+        for c in stale:
+            del self._sticky[c]
+        return len(stale)

@@ -1,0 +1,296 @@
+"""tmrouter: multi-replica serving on the fleet ledger (ISSUE 19).
+
+Stands up a fleet scheduler over one device pool, spawns N serving
+replicas as ``kind="serving"`` fleet jobs, drives seeded open-loop
+traffic through the router's per-replica durable queues, and reports
+ROUTER.json (p50/p99 router-visible TTFT, tokens/sec, the replica-count
+trajectory, and the exactly-once audit).  Training jobs submitted into
+the same fleet dir contend for the same chips: a traffic spike that
+trips the autoscaler preempts strictly-lower-priority training via the
+existing cooperative SIGTERM→75 path, and the scale-down drain returns
+the chips so training resumes elastically.
+
+Example (two replicas, autoscale up to three, toy model)::
+
+    tmrouter --fleet-dir ./fleet --pool-size 8 \
+        --replicas 2 --max-replicas 3 --replica-devices 2 \
+        --modelclass TransformerLM --set dim=64 --set n_layers=2 \
+        --requests 64 --arrival-rate 32 --out ROUTER.json
+
+The router layer imports fleet + serving *lifecycle* only — the serving
+engine/scheduler machinery always runs in replica subprocesses, never
+in the router process (the ``tmlint`` wall holds).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import sys
+import threading
+import time
+
+from theanompi_tpu.resilience.codes import EXIT_CLEAN, EXIT_CONFIG, EXIT_CRASH
+
+
+def _parse_set(pairs: list[str]) -> dict:
+    """``--set k=v`` into a config dict via literal eval (the launcher's
+    grammar, re-spelled here: the router may not import the launcher)."""
+    out = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise ValueError(f"--set expects K=V, got {pair!r}")
+        k, v = pair.split("=", 1)
+        try:
+            out[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            out[k] = v  # bare string
+    return out
+
+
+def build_parser():
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="tmrouter",
+        description="Route open-loop traffic over a pool of serving "
+        "replicas leased from the fleet ledger, with autoscale.",
+        allow_abbrev=False,
+    )
+    p.add_argument("--fleet-dir", required=True,
+                   help="the fleet scheduler's state dir (shared with any "
+                   "contending training jobs)")
+    p.add_argument("--pool-size", type=int, default=None,
+                   help="device pool size (default: persisted ledger or "
+                   "live probe)")
+    # -- replica pool --------------------------------------------------------
+    p.add_argument("--replicas", type=int, default=1,
+                   help="initial replica count (also the autoscale floor "
+                   "unless --min-replicas says otherwise)")
+    p.add_argument("--min-replicas", type=int, default=None)
+    p.add_argument("--max-replicas", type=int, default=4)
+    p.add_argument("--replica-devices", type=int, default=1,
+                   help="gang lease size per replica")
+    p.add_argument("--replica-priority", type=int, default=10,
+                   help="fleet priority of replica jobs — keep it above "
+                   "preemptible training (serving evicts training on "
+                   "scale-up, never the reverse)")
+    p.add_argument("--replica-max-restarts", type=int, default=1,
+                   help="supervised restarts per replica episode (restart "
+                   "dedup rides REQUESTS.jsonl)")
+    p.add_argument("--modelfile",
+                   default="theanompi_tpu.models.transformer_lm")
+    p.add_argument("--modelclass", default="TransformerLM")
+    p.add_argument("--set", dest="model_set", action="append", default=[],
+                   metavar="K=V", help="replica model config (repeatable)")
+    p.add_argument("--replica-arg", action="append", default=[],
+                   metavar="ARG", help="extra tmserve flag passed through "
+                   "to every replica verbatim (repeatable)")
+    # -- synthetic open-loop traffic -----------------------------------------
+    p.add_argument("--requests", type=int, default=16)
+    p.add_argument("--vocab", type=int, default=256,
+                   help="synthetic prompt token range (the router never "
+                   "imports the model; match the replica's vocab)")
+    p.add_argument("--prompt-len", type=int, default=16)
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--arrival-rate", type=float, default=0.0,
+                   help="open-loop Poisson arrivals in requests/sec "
+                   "(0 = one burst at t=0)")
+    p.add_argument("--turns", type=int, default=1,
+                   help="multi-turn sessions (consecutive rid groups are "
+                   "one conversation — sticky-routed for prefix affinity)")
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    # -- autoscale -----------------------------------------------------------
+    p.add_argument("--no-autoscale", action="store_true",
+                   help="pin the pool at --replicas (backfill of dead "
+                   "replicas stays on)")
+    p.add_argument("--up-pressure-s", type=float, default=4.0)
+    p.add_argument("--up-after-s", type=float, default=1.0)
+    p.add_argument("--down-pressure-s", type=float, default=0.5)
+    p.add_argument("--down-after-s", type=float, default=2.0)
+    p.add_argument("--cooldown-s", type=float, default=2.0)
+    p.add_argument("--ttft-slo-ms", type=float, default=None,
+                   help="rolling p99 TTFT above this scales up without "
+                   "waiting out --up-after-s")
+    p.add_argument("--default-rate", type=float, default=50.0,
+                   help="assumed tokens/sec per replica before it has "
+                   "measured one (cold-start balancing/pressure)")
+    # -- drive ---------------------------------------------------------------
+    p.add_argument("--poll-s", type=float, default=0.02,
+                   help="router tick interval")
+    p.add_argument("--timeout-s", type=float, default=300.0,
+                   help="abort the drive loop after this long (requests "
+                   "still unanswered are reported as lost)")
+    p.add_argument("--telemetry-dir", default=None,
+                   help="router.* instants/gauges as JSONL here")
+    p.add_argument("--out", default=None,
+                   help="write the report as JSON here (ROUTER.json)")
+    p.add_argument("--quiet", action="store_true")
+    return p
+
+
+def synthetic_entries(n: int, vocab: int, prompt_len: int,
+                      max_new_tokens: int, rate: float, seed: int,
+                      temperature: float = 0.0, turns: int = 1) -> list[dict]:
+    """Seeded open-loop queue entries, the dict twin of the serving CLI's
+    ``synthetic_requests`` (same turn grammar: within a conversation,
+    turn t's prompt extends turn t-1's — the sticky-routing traffic)."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    t = 0.0
+    out: list[dict] = []
+    convo_toks: list[int] = []
+    for rid in range(n):
+        if rate > 0:
+            t += float(rng.exponential(1.0 / rate))
+        if turns <= 1 or rid % turns == 0:
+            convo_toks = []
+        convo_toks = convo_toks + [
+            int(x) for x in rng.randint(0, vocab, prompt_len)]
+        out.append({
+            "rid": rid,
+            "prompt": list(convo_toks),
+            "max_new_tokens": max_new_tokens,
+            "temperature": temperature,
+            "arrival_s": round(t, 6) if rate > 0 else 0.0,
+            "convo": rid // turns if turns > 1 else None,
+        })
+    return out
+
+
+def drive_traffic(router, entries: list[dict], *, poll_s: float = 0.02,
+                  timeout_s: float = 300.0,
+                  between_ticks=None) -> tuple[dict, float]:
+    """The open-loop drive loop: submit each entry when the clock passes
+    its ``arrival_s`` (arrivals never wait on the pool), tick the router
+    until every rid is terminal or ``timeout_s`` passes, then drain the
+    pool; -> (results, wall seconds).  ``between_ticks(router, now_s)``
+    is the test seam (chaos kills, contending submits)."""
+    pending = sorted(entries, key=lambda e: e["arrival_s"])
+    want = len(pending)
+    i = 0
+    t0 = time.perf_counter()
+    while len(router.results) < want:
+        now = time.perf_counter() - t0
+        if now > timeout_s:
+            break
+        while i < len(pending) and pending[i]["arrival_s"] <= now:
+            e = pending[i]
+            i += 1
+            router.submit(e, convo=e.get("convo"))
+        if between_ticks is not None:
+            between_ticks(router, now)
+        router.tick()
+        time.sleep(poll_s)
+    wall = time.perf_counter() - t0
+    router.drain_all()
+    return dict(router.results), wall
+
+
+def run_router(args) -> dict:
+    """Build the fleet + pool + router, run the traffic; -> report."""
+    from theanompi_tpu.fleet.scheduler import FleetScheduler
+    from theanompi_tpu.router.autoscale import AutoscaleConfig, AutoscalePolicy
+    from theanompi_tpu.router.balance import Balancer
+    from theanompi_tpu.router.pool import ReplicaPool, Router
+
+    sched = FleetScheduler(args.fleet_dir, args.pool_size)
+    spec = {
+        "priority": args.replica_priority,
+        "min_devices": args.replica_devices,
+        "max_devices": args.replica_devices,
+        "modelfile": args.modelfile,
+        "modelclass": args.modelclass,
+        "model_config": _parse_set(args.model_set),
+        "max_restarts": args.replica_max_restarts,
+        "backoff_base": 0.2,
+        "extra_args": list(args.replica_arg),
+    }
+    pool = ReplicaPool(sched, spec)
+    min_replicas = (args.min_replicas if args.min_replicas is not None
+                    else args.replicas)
+    policy = None
+    if not args.no_autoscale:
+        policy = AutoscalePolicy(AutoscaleConfig(
+            min_replicas=min_replicas,
+            max_replicas=max(args.max_replicas, min_replicas),
+            up_pressure_s=args.up_pressure_s, up_after_s=args.up_after_s,
+            down_pressure_s=args.down_pressure_s,
+            down_after_s=args.down_after_s, cooldown_s=args.cooldown_s,
+            ttft_slo_ms=args.ttft_slo_ms))
+    telemetry = None
+    if args.telemetry_dir:
+        from theanompi_tpu.telemetry import Telemetry
+
+        telemetry = Telemetry(args.telemetry_dir, rank=0)
+    router = Router(pool, balancer=Balancer(), policy=policy,
+                    telemetry=telemetry, default_rate=args.default_rate)
+    for _ in range(args.replicas):
+        pool.spawn()
+
+    box: dict = {}
+    fleet_thread = threading.Thread(
+        target=lambda: box.setdefault("rc", sched.run()),
+        name="tmrouter-fleet")
+    fleet_thread.start()
+    try:
+        entries = synthetic_entries(
+            args.requests, args.vocab, args.prompt_len,
+            args.max_new_tokens, args.arrival_rate, args.seed,
+            temperature=args.temperature, turns=args.turns)
+        _results, wall = drive_traffic(
+            router, entries, poll_s=args.poll_s, timeout_s=args.timeout_s)
+    finally:
+        router.drain_all()
+        fleet_thread.join(timeout=max(args.timeout_s, 60.0))
+    report = router.report(wall_s=wall)
+    report["fleet_exit"] = box.get("rc")
+    if telemetry is not None:
+        telemetry.close()
+    return report
+
+
+def _error_line(phase: str, e: BaseException) -> None:
+    print(f"tmrouter: error: {phase}: {type(e).__name__}: {e}",
+          file=sys.stderr, flush=True)
+    if os.environ.get("THEANOMPI_DEBUG"):
+        import traceback
+
+        traceback.print_exc()
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Exit contract (the shared table): 0 = every request reached
+    exactly one terminal state, 70 = requests lost/duplicated or the
+    fleet crashed, 78 = config error."""
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    try:
+        args = build_parser().parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+    try:
+        report = run_router(args)
+    except (ImportError, AttributeError, TypeError, ValueError, KeyError,
+            FileNotFoundError, NotImplementedError) as e:
+        _error_line("config", e)
+        return EXIT_CONFIG
+    except Exception as e:
+        _error_line("router", e)
+        return EXIT_CRASH
+    if args.out:
+        with open(args.out + ".tmp", "w") as f:
+            json.dump(report, f, indent=1)
+        os.replace(args.out + ".tmp", args.out)
+    print(json.dumps(report))
+    if not args.quiet and not report.get("exactly_once"):
+        print(f"tmrouter: {report['requests'] - report['answered']} "
+              f"request(s) unanswered, {report['duplicates']} duplicated",
+              file=sys.stderr, flush=True)
+    return EXIT_CLEAN if report.get("exactly_once") else EXIT_CRASH
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,444 @@
+"""Replica pool + router over the fleet ledger (ISSUE 19 tentpole).
+
+Each serving replica is one fleet job (``JobSpec(kind="serving")``)
+holding a gang device lease and running under the PR 13 supervised seam;
+the router talks to it exclusively through the three durable files in
+its job dir (:mod:`theanompi_tpu.serving.lifecycle`):
+
+- appends requests to ``queue.jsonl`` (dispatch) and the drain sentinel
+  (scale-down);
+- tails ``REQUESTS.jsonl`` by byte offset for terminal records — the
+  exactly-once substrate: the FIRST terminal record per rid wins across
+  all replicas and attempts, later ones are counted as audited
+  duplicates;
+- reads ``SERVE_SNAPSHOT.json`` for live load (balancing evidence).
+
+No sockets, no shared memory: a replica that dies mid-request leaves its
+queue and log behind, the router redistributes the unanswered rids to
+survivors, and the REQUESTS.jsonl dedup on both ends guarantees each rid
+one terminal state.  Scale-up leases chips from the same ledger training
+uses — the fleet scheduler preempts strictly-lower-priority *training*
+jobs through the existing cooperative SIGTERM→75 path (serving replicas
+are never preemption victims; they leave only through a drain), and a
+scale-down drain returns the chips, at which point the preempted
+training job resumes elastically.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from theanompi_tpu.fleet.jobs import JobSpec, job_dir
+from theanompi_tpu.fleet.jobs import TERMINAL as JOB_TERMINAL
+from theanompi_tpu.router.autoscale import AutoscalePolicy
+from theanompi_tpu.router.balance import Balancer, est_wait_s
+from theanompi_tpu.serving.lifecycle import (
+    QUEUE_LOG,
+    REQUESTS_LOG,
+    SNAPSHOT,
+    append_queue,
+    read_jsonl_since,
+    read_snapshot,
+    request_drain,
+)
+from theanompi_tpu.telemetry.metrics import (  # registered names (ISSUE 6)
+    ROUTER_COUNTERS,
+    ROUTER_GAUGES,
+    ROUTER_INSTANTS,
+)
+
+(_INST_DISPATCH, _INST_REDISTRIBUTE, _INST_DEAD, _INST_UP, _INST_DOWN,
+ _INST_DUP) = ROUTER_INSTANTS
+_G_REPLICAS, _G_BACKLOG, _G_TTFT_P99 = ROUTER_GAUGES
+_CNT_REQUESTS, _CNT_REDISTRIBUTED = ROUTER_COUNTERS
+
+#: a shed record whose reason starts with this marks a drain casualty —
+#: the replica gave the request back, it is NOT a final answer
+DRAIN_SHED_REASON = "draining"
+
+
+class ReplicaPool:
+    """Numbered serving replicas as fleet jobs on one scheduler.
+
+    ``spec`` holds the :class:`JobSpec` keyword arguments every replica
+    shares (devices, priority, model config or an explicit ``argv`` test
+    seam) — ``job_id`` and ``kind`` are owned here.  The pool only ever
+    *submits*, *drains*, and *reads*; launching, supervising, preempting
+    training victims, and lease bookkeeping all stay the fleet
+    scheduler's job.
+    """
+
+    def __init__(self, sched, spec: dict, *, prefix: str = "replica"):
+        self.sched = sched
+        self.spec = dict(spec)
+        self.spec.pop("job_id", None)
+        self.spec.pop("kind", None)
+        self.prefix = prefix
+        self._n = 0
+        self.replicas: list[str] = []  #: every job id ever spawned
+        self.draining: set[str] = set()
+
+    # -- paths ---------------------------------------------------------------
+    def jdir(self, jid: str) -> str:
+        return job_dir(self.sched.fleet_dir, jid)
+
+    def queue_path(self, jid: str) -> str:
+        return os.path.join(self.jdir(jid), QUEUE_LOG)
+
+    def requests_log(self, jid: str) -> str:
+        return os.path.join(self.jdir(jid), REQUESTS_LOG)
+
+    def snapshot(self, jid: str) -> dict | None:
+        return read_snapshot(os.path.join(self.jdir(jid), SNAPSHOT))
+
+    # -- lifecycle -----------------------------------------------------------
+    def spawn(self) -> str:
+        """Submit one more replica job; -> its job id.  The queue file is
+        created eagerly so dispatch can target the replica while it is
+        still queued for devices (work waits durably in the queue).  The
+        child env carries ``THEANOMPI_JOB_DIR`` so argv-seam replicas
+        (tests, custom servers) can find their queue/log without flags —
+        real tmserve children get explicit paths from build_child_cmd."""
+        jid = f"{self.prefix}-{self._n}"
+        self._n += 1
+        append_queue(self.queue_path(jid), [])  # touch: dispatchable now
+        env = dict(self.spec.get("env") or {})
+        env.setdefault("THEANOMPI_JOB_DIR", self.jdir(jid))
+        spec_kw = dict(self.spec)
+        spec_kw["env"] = env
+        self.sched.submit(JobSpec(job_id=jid, kind="serving", **spec_kw))
+        self.replicas.append(jid)
+        return jid
+
+    def drain(self, jid: str) -> None:
+        """Graceful scale-down: append the durable drain sentinel — the
+        replica finishes everything already queued, exits clean, the
+        fleet marks it done and releases its lease."""
+        self.draining.add(jid)
+        request_drain(self.queue_path(jid))
+
+    def status(self, jid: str) -> str:
+        with self.sched._lock:
+            rec = self.sched.records.get(jid)
+            return rec.status if rec is not None else "unknown"
+
+    def dispatchable(self) -> list[str]:
+        """Replicas a new request may target: not draining, job not
+        terminal.  A replica still *queued* for devices qualifies — its
+        durable queue already exists, and rejecting it would deadlock
+        cold starts (no replica has devices before the first pass)."""
+        return [jid for jid in self.replicas
+                if jid not in self.draining
+                and self.status(jid) not in JOB_TERMINAL
+                and self.status(jid) != "unknown"]
+
+
+class Router:
+    """Admission, balancing, redistribution, and autoscale over a pool.
+
+    Single-threaded by design: callers drive :meth:`submit` (open-loop
+    arrivals) and :meth:`tick` (poll + scale) from one loop, the same
+    shape as the serving scheduler's drive loops.  All cross-process
+    coordination is the durable files — see the module docstring.
+    """
+
+    def __init__(self, pool: ReplicaPool, *, balancer: Balancer | None =
+                 None, policy: AutoscalePolicy | None = None,
+                 telemetry=None, default_rate: float = 50.0):
+        self.pool = pool
+        self.balancer = balancer or Balancer()
+        self.policy = policy
+        self.telemetry = telemetry
+        self.default_rate = float(default_rate)
+        self.entries: dict[int, dict] = {}    #: rid -> queue entry
+        self.assigned: dict[int, str] = {}    #: rid -> current replica
+        self.attempts: dict[int, int] = {}    #: rid -> dispatch count
+        self.results: dict[int, dict] = {}    #: rid -> FIRST terminal rec
+        self.n_requests = 0
+        self.n_duplicates = 0
+        self.n_redistributed = 0
+        self.ttft_ms: list[float] = []        #: router-visible (queue+ttft)
+        self._offsets: dict[str, int] = {}    #: REQUESTS.jsonl byte offsets
+        self._dead: set[str] = set()
+        self.trajectory: list[list[float]] = []  #: [rel wall s, n live]
+        self.t0 = time.time()  # lint: wall-ok — report timeline origin
+
+    # -- helpers -------------------------------------------------------------
+    def _emit(self, name: str, **fields) -> None:
+        if self.telemetry is not None:
+            self.telemetry.instant(name, **fields)
+
+    def _count(self, name: str, n: int = 1) -> None:
+        if self.telemetry is not None:
+            self.telemetry.count(name, n)
+
+    def owed_tokens(self, jid: str) -> int:
+        """The router's ledger of unanswered token budget on ``jid``."""
+        return sum(int(self.entries[rid].get("max_new_tokens", 16))
+                   for rid, j in self.assigned.items()
+                   if j == jid and rid not in self.results)
+
+    def unanswered(self, jid: str) -> list[int]:
+        return [rid for rid, j in self.assigned.items()
+                if j == jid and rid not in self.results]
+
+    def _candidates(self) -> list[str]:
+        return [jid for jid in self.pool.dispatchable()
+                if jid not in self._dead]
+
+    def _waits(self, cands: list[str]) -> dict[str, float]:
+        return {jid: est_wait_s(self.owed_tokens(jid),
+                                self.pool.snapshot(jid),
+                                self.default_rate)
+                for jid in cands}
+
+    def rolling_ttft_p99(self, window: int = 64) -> float | None:
+        xs = self.ttft_ms[-window:]
+        if not xs:
+            return None
+        return float(np.percentile(np.asarray(xs), 99))
+
+    # -- admission -----------------------------------------------------------
+    def submit(self, entry: dict, convo: int | None = None) -> str:
+        """Admit one request: stamp it, pick a replica, append to its
+        durable queue; -> the chosen replica's job id.  ``entry`` needs
+        at least rid + prompt; ``convo`` engages sticky routing."""
+        rid = int(entry["rid"])
+        cands = self._candidates()
+        if not cands:
+            cands = [self.pool.spawn()]  # cold pool: traffic forces one
+        jid, sticky = self.balancer.choose(self._waits(cands), convo)
+        e = dict(entry)
+        e.setdefault("enq_wall",
+                     time.time())  # lint: wall-ok — cross-process stamp
+        append_queue(self.pool.queue_path(jid), [e])
+        first = rid not in self.entries
+        self.entries[rid] = e
+        self.assigned[rid] = jid
+        self.attempts[rid] = self.attempts.get(rid, 0) + 1
+        if first:
+            self.n_requests += 1
+            self._count(_CNT_REQUESTS)
+        self._emit(_INST_DISPATCH, request=rid, replica=jid, sticky=sticky)
+        return jid
+
+    # -- harvest + redistribution --------------------------------------------
+    def _redistribute(self, rids: list[int], *, exclude: str,
+                      why: str) -> int:
+        """Re-dispatch unanswered rids away from ``exclude``; -> how many
+        moved (0 when no survivor exists yet — they stay owed to the
+        dead replica and the next tick, after a backfill spawn, moves
+        them)."""
+        moved = 0
+        for rid in rids:
+            if rid in self.results:
+                continue
+            cands = [j for j in self._candidates() if j != exclude]
+            if not cands:
+                return moved
+            jid, _ = self.balancer.choose(self._waits(cands),
+                                          self.entries[rid].get("convo"))
+            # the original enq_wall survives the move: the user has been
+            # waiting since the FIRST enqueue, and the report must say so
+            append_queue(self.pool.queue_path(jid), [self.entries[rid]])
+            self.assigned[rid] = jid
+            self.attempts[rid] = self.attempts.get(rid, 0) + 1
+            moved += 1
+        if moved:
+            self.n_redistributed += moved
+            self._count(_CNT_REDISTRIBUTED, moved)
+            self._emit(_INST_REDISTRIBUTE, replica=exclude, n=moved,
+                       why=why)
+        return moved
+
+    def poll(self) -> int:
+        """Tail every replica's REQUESTS.jsonl; -> newly terminal rids.
+
+        First terminal record per rid wins (REQUESTS dedup gives
+        exactly-once per replica; this gives it across replicas — a rid
+        redistributed off a replica that was merely slow, not dead, can
+        legally produce two records, and the audit counts the loser).
+        A ``shed`` record with the drain reason is a give-back, not an
+        answer: the replica drained with the rid still queued, so the
+        rid is redistributed instead of finalized."""
+        fresh = 0
+        for jid in list(self.pool.replicas):
+            recs, self._offsets[jid] = read_jsonl_since(
+                self.pool.requests_log(jid), self._offsets.get(jid, 0))
+            give_backs: list[int] = []
+            for rec in recs:
+                rid = int(rec.get("rid", -1))
+                if rid not in self.entries:
+                    continue  # not this router's traffic
+                if (rec.get("state") == "shed"
+                        and str(rec.get("reason") or "").startswith(
+                            DRAIN_SHED_REASON)):
+                    if rid not in self.results:
+                        give_backs.append(rid)
+                    continue
+                if rid in self.results:
+                    self.n_duplicates += 1
+                    self._emit(_INST_DUP, request=rid, replica=jid)
+                    continue
+                rec = dict(rec)
+                rec["replica"] = jid
+                self.results[rid] = rec
+                fresh += 1
+                if rec.get("state") == "done" and "ttft_ms" in rec:
+                    self.ttft_ms.append(
+                        float(rec.get("queue_wait_ms", 0.0))
+                        + float(rec["ttft_ms"]))
+            if give_backs:
+                self._redistribute(give_backs, exclude=jid,
+                                   why="drain give-back")
+        return fresh
+
+    def absorb_dead(self) -> int:
+        """Find replicas whose fleet job went terminal while still owing
+        answers, mark them dead, move their unanswered rids to
+        survivors; -> rids moved."""
+        moved = 0
+        for jid in list(self.pool.replicas):
+            status = self.pool.status(jid)
+            if status not in JOB_TERMINAL:
+                continue
+            orphans = self.unanswered(jid)
+            if jid not in self._dead and (orphans or status == "failed"):
+                self._dead.add(jid)
+                self.balancer.forget_replica(jid)
+                self._emit(_INST_DEAD, replica=jid, status=status,
+                           orphans=len(orphans))
+            if orphans:
+                # retried every tick: with no survivor yet (e.g. the
+                # whole pool died at once) the rids stay owed here until
+                # a backfill spawn gives them somewhere to go
+                moved += self._redistribute(orphans, exclude=jid,
+                                            why=f"replica {status}")
+        return moved
+
+    # -- autoscale -----------------------------------------------------------
+    def live_replicas(self) -> list[str]:
+        return self._candidates()
+
+    def pool_pressure_s(self) -> float:
+        """Seconds of queued-but-unanswered work across the pool at its
+        current aggregate rate (the autoscale policy's input)."""
+        live = self._candidates()
+        owed = sum(self.owed_tokens(j) for j in live)
+        # also count work still owed to dead replicas awaiting backfill
+        owed += sum(self.owed_tokens(j) for j in self._dead)
+        rate = 0.0
+        for j in live:
+            snap = self.pool.snapshot(j)
+            measured = snap.get("token_rate") if snap else None
+            rate += float(measured) if measured else self.default_rate
+        if rate <= 0:
+            rate = self.default_rate
+        return owed / rate
+
+    def scale_tick(self) -> str | None:
+        """One autoscale judgement: backfill below the floor first (a
+        dead replica's lease is re-leased regardless of pressure), then
+        let the policy weigh pressure/SLO; -> the action taken."""
+        live = self._candidates()
+        floor = self.policy.cfg.min_replicas if self.policy else 1
+        pressure = self.pool_pressure_s()
+        p99 = self.rolling_ttft_p99()
+        if self.telemetry is not None:
+            self.telemetry.gauge(_G_REPLICAS, len(live))
+            self.telemetry.gauge(_G_BACKLOG, sum(
+                self.owed_tokens(j) for j in live))
+            if p99 is not None:
+                self.telemetry.gauge(_G_TTFT_P99, round(p99, 3))
+        if len(live) < floor:
+            jid = self.pool.spawn()
+            self._emit(_INST_UP, replica=jid,
+                       pressure_s=round(pressure, 3),
+                       replicas=len(live) + 1, backfill=True)
+            return "up"
+        if self.policy is None:
+            return None
+        decision = self.policy.observe(len(live), pressure,
+                                       ttft_p99_ms=p99)
+        if decision == "up":
+            jid = self.pool.spawn()
+            self._emit(_INST_UP, replica=jid,
+                       pressure_s=round(pressure, 3),
+                       replicas=len(live) + 1, backfill=False)
+        elif decision == "down":
+            # drain the replica carrying the least outstanding work —
+            # cheapest to finish, and its chips free fastest
+            jid = min(live, key=lambda j: (self.owed_tokens(j), j))
+            self.pool.drain(jid)
+            self.balancer.forget_replica(jid)
+            self._emit(_INST_DOWN, replica=jid,
+                       pressure_s=round(pressure, 3),
+                       replicas=len(live) - 1)
+        return decision
+
+    def tick(self) -> int:
+        """One router pass: harvest, absorb deaths, autoscale, record
+        the replica-count trajectory point; -> newly terminal rids."""
+        fresh = self.poll()
+        self.absorb_dead()
+        self.scale_tick()
+        now = time.time()  # lint: wall-ok — report timeline stamp
+        n_live = len(self._candidates())
+        if not self.trajectory or self.trajectory[-1][1] != n_live:
+            self.trajectory.append([round(now - self.t0, 3), n_live])
+        return fresh
+
+    def drain_all(self) -> None:
+        """End of traffic: sentinel every non-dead replica down (they
+        finish queued work, exit clean, leases release)."""
+        for jid in self.pool.replicas:
+            if jid in self._dead or jid in self.pool.draining:
+                continue
+            if self.pool.status(jid) not in JOB_TERMINAL:
+                self.pool.drain(jid)
+
+    def report(self, wall_s: float | None = None) -> dict:
+        """The ROUTER.json artifact: exactly-once audit + latency +
+        replica trajectory."""
+        wall = (wall_s if wall_s is not None
+                else time.time() - self.t0)  # lint: wall-ok — report span
+        n_tokens = sum(int(r.get("n_generated", 0))
+                       for r in self.results.values())
+        states: dict[str, int] = {}
+        for r in self.results.values():
+            s = str(r.get("state"))
+            states[s] = states.get(s, 0) + 1
+
+        def pct(xs):
+            if not xs:
+                return {}
+            arr = np.asarray(xs)
+            return {"p50": round(float(np.percentile(arr, 50)), 3),
+                    "p99": round(float(np.percentile(arr, 99)), 3)}
+
+        return {
+            "metric": "router_tokens_per_sec",
+            "value": round(n_tokens / wall, 2) if wall > 0 else 0.0,
+            "unit": "tokens/sec",
+            "requests": self.n_requests,
+            "answered": len(self.results),
+            "generated_tokens": n_tokens,
+            "wall_s": round(wall, 3),
+            "terminal_states": states,
+            # every rid exactly one terminal state, none lost, none
+            # double-counted — THE acceptance line
+            "exactly_once": (len(self.results) == self.n_requests
+                             and self.n_duplicates == 0),
+            "duplicates": self.n_duplicates,
+            "redistributed": self.n_redistributed,
+            "ttft_ms": pct(self.ttft_ms),
+            "replicas_spawned": len(self.pool.replicas),
+            "replicas_dead": len(self._dead),
+            "replicas_peak": max((n for _, n in self.trajectory),
+                                 default=0),
+            "replica_trajectory": list(self.trajectory),
+            "max_attempts": max(self.attempts.values(), default=0),
+        }

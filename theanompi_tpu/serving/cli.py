@@ -123,6 +123,20 @@ def build_parser() -> argparse.ArgumentParser:
                    "(default <telemetry-dir>/REQUESTS.jsonl when telemetry "
                    "is on); a restarted --supervise attempt reads it back "
                    "and skips already-answered ids")
+    # -- router replica mode (ISSUE 19) ------------------------------------
+    p.add_argument("--queue-file", default=None,
+                   help="serve a durable admission queue instead of "
+                   "synthetic traffic: tail this JSONL file for request "
+                   "entries appended by tmrouter, exit clean on its "
+                   "{\"op\": \"drain\"} sentinel (REQUESTS.jsonl and "
+                   "SERVE_SNAPSHOT.json default into its directory)")
+    p.add_argument("--snapshot", default=None,
+                   help="publish the scheduler's live load here atomically "
+                   "(default: next to --queue-file, else "
+                   "<telemetry-dir>/SERVE_SNAPSHOT.json; the router "
+                   "balances on this)")
+    p.add_argument("--snapshot-every", type=int, default=8,
+                   help="scheduler steps between live-snapshot publishes")
     p.add_argument("--supervise", action="store_true",
                    help="run the replica as a supervised child through the "
                    "shared run_job seam: crash classification, bounded "
@@ -234,15 +248,26 @@ def serve(args) -> dict:
     from theanompi_tpu.serving.engine import InferenceEngine
     from theanompi_tpu.serving.lifecycle import (
         REQUESTS_LOG,
+        SNAPSHOT,
         RequestLog,
+        SnapshotPublisher,
         terminal_rids,
     )
     from theanompi_tpu.serving.scheduler import (
         Scheduler,
         run_open_loop,
+        run_queue_loop,
         serve_report,
     )
     from theanompi_tpu.utils.checkpoint import load_for_inference
+
+    if os.environ.get("THEANOMPI_COMPILE_CACHE"):
+        # router/fleet replica children inherit the session compile cache
+        # the same way tmlauncher's __main__ does (ISSUE 19 satellite):
+        # the first replica compiles, every later one loads
+        from theanompi_tpu.parallel.mesh import setup_compile_cache
+
+        setup_compile_cache()
 
     cls = getattr(importlib.import_module(args.modelfile), args.modelclass)
     model = cls(_parse_kv(args.model_set))
@@ -302,7 +327,8 @@ def serve(args) -> dict:
         telemetry.instant(SERVE_DECODE_KERNEL_INSTANTS[0],
                           impl=engine.decode_impl,
                           requested=getattr(args, "decode_kernel", "auto"))
-    reqs = synthetic_requests(
+    queue_file = getattr(args, "queue_file", None)
+    reqs = [] if queue_file else synthetic_requests(
         args.requests, model.data.vocab, args.prompt_len,
         args.max_new_tokens, args.arrival_rate, args.seed,
         args.temperature, ttft_deadline_ms=args.ttft_deadline_ms,
@@ -311,18 +337,35 @@ def serve(args) -> dict:
         shared_prefix=getattr(args, "shared_prefix_len", 0))
 
     # -- durable terminal-state log + restart dedup (ISSUE 14) -------------
+    # queue mode (ISSUE 19): the log defaults NEXT TO the queue file so
+    # the router finds it without extra plumbing
     log_path = args.requests_log or (
         os.path.join(args.telemetry_dir, REQUESTS_LOG)
-        if args.telemetry_dir else None)
+        if args.telemetry_dir else
+        os.path.join(os.path.dirname(os.path.abspath(queue_file)),
+                     REQUESTS_LOG) if queue_file else None)
     req_log = None
+    answered: set[int] = set()
     n_skipped = 0
     if log_path:
         answered = terminal_rids(log_path)
-        if answered:
+        if answered and not queue_file:
             before = len(reqs)
             reqs = [r for r in reqs if r.rid not in answered]
             n_skipped = before - len(reqs)
         req_log = RequestLog(log_path, attempt=attempt)
+    if queue_file and answered:
+        n_skipped = len(answered)
+
+    # -- live load snapshot (ISSUE 19 satellite) ---------------------------
+    snap_path = getattr(args, "snapshot", None) or (
+        os.path.join(os.path.dirname(os.path.abspath(queue_file)), SNAPSHOT)
+        if queue_file else
+        os.path.join(args.telemetry_dir, SNAPSHOT)
+        if args.telemetry_dir else None)
+    snapshot = (SnapshotPublisher(
+        snap_path, every_steps=getattr(args, "snapshot_every", 8))
+        if snap_path else None)
 
     # -- graceful drain: SIGTERM -> drain within --drain-s, exit clean -----
     drain_ev = threading.Event()
@@ -344,10 +387,19 @@ def serve(args) -> dict:
             telemetry=telemetry, fault_plan=fault_plan)
 
     try:
-        results, wall_s = run_open_loop(
-            sched, reqs, drain=drain_ev.is_set, drain_s=args.drain_s,
-            on_terminal=req_log.record if req_log else None,
-            between_steps=rollout.poll if rollout else None)
+        if queue_file:
+            results, wall_s = run_queue_loop(
+                sched, queue_file, drain=drain_ev.is_set,
+                drain_s=args.drain_s,
+                on_terminal=req_log.record if req_log else None,
+                between_steps=rollout.poll if rollout else None,
+                snapshot=snapshot, answered=answered)
+        else:
+            results, wall_s = run_open_loop(
+                sched, reqs, drain=drain_ev.is_set, drain_s=args.drain_s,
+                on_terminal=req_log.record if req_log else None,
+                between_steps=rollout.poll if rollout else None,
+                snapshot=snapshot)
     finally:
         if prev_term is not None:
             signal.signal(signal.SIGTERM, prev_term)
@@ -361,6 +413,8 @@ def serve(args) -> dict:
         report["skipped_already_answered"] = n_skipped
     if log_path:
         report["requests_log"] = log_path
+    if queue_file:
+        report["queue_file"] = queue_file
     if rollout is not None:
         report["rollout"] = {"rollouts": rollout.n_rollouts,
                              "rollbacks": rollout.n_rollbacks,

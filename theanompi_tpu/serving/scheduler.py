@@ -50,6 +50,7 @@ import numpy as np
 
 from theanompi_tpu.resilience.faults import FaultInjected, FaultPlan
 from theanompi_tpu.serving.kv_cache import BlockPool, PagedKVCache, blocks_for
+from theanompi_tpu.serving.lifecycle import DRAIN_OP, read_jsonl_since
 from theanompi_tpu.serving.prefix_cache import PrefixCache
 from theanompi_tpu.telemetry.metrics import (  # registered names (ISSUE 6)
     SERVE_COUNTERS,
@@ -150,6 +151,7 @@ class Scheduler:
         self.step_ms: list[float] = []  # one entry per decode step
         self.ttft_ms: list[float] = []
         self.n_preemptions = 0
+        self.n_done = 0
         self.n_expired = 0
         self.n_shed = 0
         self.n_failed = 0
@@ -187,6 +189,31 @@ class Scheduler:
             if req is not None:
                 owed += max(req.max_new_tokens - len(req.generated), 0)
         return owed
+
+    def snapshot(self) -> dict:
+        """Live load for the router's balancer (ISSUE 19 satellite):
+        backlog, recent rate, terminal tallies, prefix-hit rate.  Plain
+        host ints/floats only — this dict goes straight through
+        :func:`theanompi_tpu.serving.lifecycle.publish_snapshot`."""
+        rate = self.recent_token_rate()
+        return {
+            # wall (not perf_counter) so the ROUTER side can judge
+            # freshness across processes
+            "updated": time.time(),  # lint: wall-ok — cross-process stamp
+            "backlog_tokens": self._backlog_tokens(),
+            "queue_len": len(self.queue),
+            "n_active": self.n_active,
+            "token_rate": round(rate, 3) if rate is not None else None,
+            "decode_steps": self.n_steps,
+            "n_done": self.n_done,
+            "n_expired": self.n_expired,
+            "n_shed": self.n_shed,
+            "n_failed": self.n_failed,
+            "draining": self.draining,
+            "prefix_hit_rate": (
+                round(self.n_prefix_hits / self.n_prefix_lookups, 4)
+                if self.n_prefix_lookups else 0.0),
+        }
 
     # -- submission ----------------------------------------------------------
     def submit(self, req: Request) -> bool:
@@ -275,6 +302,7 @@ class Scheduler:
         req = self._evict(slot)
         req.state = "done"
         req.t_done = time.perf_counter()
+        self.n_done += 1
         if self.telemetry is not None:
             self.telemetry.count(_CNT_REQUESTS)
         self._emit(_INST_FINISH, request=req.rid,
@@ -641,7 +669,8 @@ class Scheduler:
 def run_open_loop(scheduler: Scheduler, requests: list[Request],
                   poll_s: float = 0.002, *, drain=None,
                   drain_s: float = 5.0, on_terminal=None,
-                  between_steps=None) -> tuple[dict[int, Request], float]:
+                  between_steps=None,
+                  snapshot=None) -> tuple[dict[int, Request], float]:
     """Drive synthetic open-loop traffic: each request is submitted when the
     wall clock passes its ``arrival_s`` (arrivals never wait on the server —
     that is what makes the load open-loop), then the scheduler steps until
@@ -655,7 +684,11 @@ def run_open_loop(scheduler: Scheduler, requests: list[Request],
     half of ``tmserve --drain-s``.  ``on_terminal(req)`` fires once per
     terminal request (the CLI's REQUESTS.jsonl writer).
     ``between_steps(scheduler)`` runs every pass — the rollout watcher's
-    between-steps poll point.
+    between-steps poll point.  ``snapshot``: an optional
+    :class:`~theanompi_tpu.serving.lifecycle.SnapshotPublisher` whose
+    ``maybe`` is offered the live scheduler load every pass (ISSUE 19
+    satellite — the router balances on this, not the end-of-drive
+    SERVE.json).
     """
     pending = deque(sorted(requests, key=lambda r: r.arrival_s))
     results: dict[int, Request] = {}
@@ -671,6 +704,8 @@ def run_open_loop(scheduler: Scheduler, requests: list[Request],
     while len(results) < len(requests):
         if between_steps is not None:
             between_steps(scheduler)
+        if snapshot is not None:
+            snapshot.maybe(scheduler.snapshot, scheduler.n_steps)
         if drain is not None and not draining and drain():
             draining = True
             drain_deadline = time.perf_counter() + drain_s
@@ -700,6 +735,106 @@ def run_open_loop(scheduler: Scheduler, requests: list[Request],
             break
     if draining:
         scheduler.end_drain()
+    if snapshot is not None:  # final publish: terminal tallies land
+        snapshot.maybe(scheduler.snapshot, scheduler.n_steps, force=True)
+    return results, time.perf_counter() - t0
+
+
+def run_queue_loop(scheduler: Scheduler, queue_path: str,
+                   poll_s: float = 0.002, *, drain=None,
+                   drain_s: float = 5.0, on_terminal=None,
+                   between_steps=None, snapshot=None,
+                   answered: set[int] | None = None,
+                   ) -> tuple[dict[int, Request], float]:
+    """Drive a replica off its durable admission queue (ISSUE 19).
+
+    The router appends request entries to ``queue_path`` (see
+    :func:`theanompi_tpu.serving.lifecycle.append_queue`); this loop tails
+    the file by byte offset, submits each entry as it appears, and keeps
+    running until a ``{"op": "drain"}`` sentinel arrives (finish what is
+    in flight, then exit) or the ``drain`` callable trips (the SIGTERM
+    path: shed queued work with reason "draining", decode in-flight
+    requests for up to ``drain_s``, force-expire the rest).
+
+    ``answered``: rids already terminal in a previous attempt (restart
+    dedup off REQUESTS.jsonl) — their queue entries are skipped silently,
+    NOT re-served and NOT re-recorded.  Each terminal callback receives
+    the extra ``queue_wait_ms`` (wall delta from the entry's ``enq_wall``
+    stamp to submission) so the router can reconstruct router-visible
+    TTFT without a shared monotonic clock.
+
+    -> ({rid: terminal request}, wall seconds).
+    """
+    results: dict[int, Request] = {}
+    answered = set() if answered is None else set(answered)
+    queue_wait_ms: dict[int, float] = {}
+
+    def _terminal(req: Request) -> None:
+        results[req.rid] = req
+        if on_terminal is not None:
+            extra = {}
+            if req.rid in queue_wait_ms:
+                extra["queue_wait_ms"] = queue_wait_ms[req.rid]
+            on_terminal(req, **extra)
+
+    def _entry_to_request(e: dict) -> Request:
+        return Request(
+            rid=int(e["rid"]),
+            prompt=list(e["prompt"]),
+            max_new_tokens=int(e.get("max_new_tokens", 16)),
+            temperature=float(e.get("temperature", 0.0)),
+            ttft_deadline_ms=e.get("ttft_deadline_ms"),
+            total_deadline_ms=e.get("total_deadline_ms"),
+        )
+
+    offset = 0
+    drain_seen = False        # durable sentinel: finish in-flight, exit
+    sig_draining = False      # SIGTERM: shed + bounded decode + expire
+    drain_deadline = 0.0
+    t0 = time.perf_counter()
+    while True:
+        if between_steps is not None:
+            between_steps(scheduler)
+        if snapshot is not None:
+            snapshot.maybe(scheduler.snapshot, scheduler.n_steps)
+        if not sig_draining:
+            entries, offset = read_jsonl_since(queue_path, offset)
+            for e in entries:
+                if e.get("op") == DRAIN_OP:
+                    drain_seen = True
+                    continue
+                if "rid" not in e or int(e["rid"]) in answered:
+                    continue
+                req = _entry_to_request(e)
+                if "enq_wall" in e:
+                    # wall (not perf_counter): the enqueue stamp came from
+                    # the router's process
+                    now = time.time()  # lint: wall-ok — cross-process dwell
+                    queue_wait_ms[req.rid] = round(
+                        max(now - float(e["enq_wall"]), 0.0) * 1e3, 3)
+                answered.add(req.rid)  # one submission per rid per attempt
+                if not scheduler.submit(req):
+                    _terminal(req)
+        if drain is not None and not sig_draining and drain():
+            sig_draining = True
+            drain_deadline = time.perf_counter() + drain_s
+            for req in scheduler.begin_drain():
+                _terminal(req)
+        if scheduler.idle:
+            if drain_seen or sig_draining:
+                break
+            time.sleep(poll_s)
+            continue
+        for req in scheduler.step():
+            _terminal(req)
+        if sig_draining and time.perf_counter() >= drain_deadline:
+            for req in scheduler.expire_all_active("drain deadline"):
+                _terminal(req)
+            break
+    if sig_draining:
+        scheduler.end_drain()
+    if snapshot is not None:
+        snapshot.maybe(scheduler.snapshot, scheduler.n_steps, force=True)
     return results, time.perf_counter() - t0
 
 

@@ -47,6 +47,7 @@ DEFAULT_TOLERANCE = 0.10
 BACKFILL_PATTERNS = ("BENCH_r*.json", "BENCH_mfu_ladder.json",
                      "BENCH_transformer.json", "BENCH_unavailable.json",
                      "SCALING*.json", "EXCHANGE*.json", "SERVE*.json",
+                     "ROUTER*.json",
                      "ROOFLINE*.json", "ATTRIB.json")
 
 #: unit substrings that mean lower-is-better; everything else (rates,
@@ -176,6 +177,29 @@ def classify_artifact(name: str, payload: dict) -> list[dict]:
                                             f"serve.{field}",
                                             payload[field], unit,
                                             run_id=run_id))
+        return recs
+    # ROUTER.json: the tmrouter multi-replica report (ISSUE 19).  Same
+    # trap as SERVE — it carries top-level ``metric``/``value``, so it
+    # MUST precede the bare bench-line branch or the TTFT percentiles
+    # and replica-count trajectory would be dropped.
+    if base.startswith("ROUTER"):
+        recs = []
+        tps = payload.get("value", payload.get("tokens_per_sec"))
+        if tps is not None:
+            recs.append(make_record(base, "router",
+                                    "router.tokens_per_sec", tps,
+                                    "tokens/sec", run_id=run_id))
+        pcts = payload.get("ttft_ms")
+        pcts = pcts if isinstance(pcts, dict) else {}
+        for p in ("p50", "p99"):
+            if pcts.get(p) is not None:
+                recs.append(make_record(base, "router",
+                                        f"router.ttft_{p}_ms", pcts[p],
+                                        "ms", run_id=run_id))
+        if payload.get("replicas_peak") is not None:
+            recs.append(make_record(base, "router", "router.replicas_peak",
+                                    payload["replicas_peak"], "replicas",
+                                    run_id=run_id))
         return recs
     # BENCH_transformer.json / a bare bench line
     if "metric" in payload and "value" in payload:

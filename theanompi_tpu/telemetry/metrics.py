@@ -155,9 +155,36 @@ DATA_COUNTERS = ("data.retries",)
 # job's final episode ended (tags: job, exit_code); ``fleet.hang``: a
 # running job's HEALTH.json published a critical hang verdict (ISSUE 13 —
 # tags: job, reason, step; the job's supervisor does the kill+restart, this
-# event is the fleet-level audit line).
+# event is the fleet-level audit line); ``fleet.drain``: a serving replica
+# was asked to drain and exit clean — the router's scale-down path, ISSUE
+# 19 (tags: job).
 FLEET_INSTANTS = ("fleet.schedule", "fleet.preempt", "fleet.resume",
-                  "fleet.complete", "fleet.fail", "fleet.hang")
+                  "fleet.complete", "fleet.fail", "fleet.hang",
+                  "fleet.drain")
+
+# -- router names (ISSUE 19) --------------------------------------------------
+# The multi-replica router emits through these registered names ONLY (same
+# one-source-of-truth contract as every family above).
+# ``router.dispatch``: a request was appended to a replica's durable queue
+# (tags: request, replica, sticky — whether conversation affinity chose the
+# target); ``router.redistribute``: a dead replica's unanswered rids were
+# re-appended to survivors' queues (tags: replica, n); ``router.replica_dead``:
+# a replica's fleet job turned terminal with work outstanding (tags: replica,
+# status); ``router.scale_up``/``router.scale_down``: the autoscale policy
+# grew/drained the pool (tags: replica, pressure_s, replicas);
+# ``router.duplicate``: a rid reached a second terminal record across
+# replicas — the first one won, this is the exactly-once audit witness
+# (tags: request, replica).
+ROUTER_INSTANTS = ("router.dispatch", "router.redistribute",
+                   "router.replica_dead", "router.scale_up",
+                   "router.scale_down", "router.duplicate")
+#: live pool state, gauged each router tick: replica count, aggregate
+#: queued-but-unanswered tokens, rolling router-visible p99 TTFT
+ROUTER_GAUGES = ("router.replicas", "router.backlog_tokens",
+                 "router.ttft_p99_ms")
+#: totals: requests admitted into the router, rids redistributed off dead
+#: replicas
+ROUTER_COUNTERS = ("router.requests", "router.redistributed")
 
 # -- resilience instant names (ISSUE 13) -------------------------------------
 # The resilience layer emits through these registered names ONLY (same
