@@ -215,7 +215,8 @@ def test_easgd_single_worker_exact_exchange():
     leaf = lambda tree, i: np.asarray(jax.tree.leaves(tree)[i])
     p0, c0 = leaf(t.params, 0)[0].copy(), leaf(t.center, 0).copy()
     assert not np.allclose(p0, c0)  # the step must have moved the worker
-    new_p, new_c = t._exchange_fn(t.params, t.center)
+    new_p, new_c, drift = t._exchange_fn(t.params, t.center)
+    assert float(drift[0]) > 0.0  # pre-exchange divergence is measured
     a = t.alpha
     np.testing.assert_allclose(
         leaf(new_p, 0)[0], p0 - a * (p0 - c0), rtol=1e-5, atol=1e-6)

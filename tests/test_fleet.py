@@ -637,3 +637,148 @@ def test_fleet_two_job_contention_preempt_elastic_resume_bit_equal(
         "ckpt_e0002.manifest.json")))
     assert man["fingerprint"]["mesh"]["data"] == 4
     assert man["data_state"]["completed"] is True
+
+
+@pytest.mark.faultinject
+def test_fleet_chaos_easgd_straggler_absorbed_under_preemption(
+        tmp_path, monkeypatch, subproc_compile_cache):
+    """THE ISSUE 20 chaos acceptance, end to end on the CPU mesh8 pool:
+
+    an EASGD job (low priority, tau=1, cadence saves) owns all 8
+    devices; a priority-10 BSP job preempts it (cooperative exit 75); it
+    resumes **elastically** on the 4 devices left via the new stacked
+    reshard plan while absorbing injected stragglers — the
+    async_staleness detector must reach WARN (degraded, absorbed) and
+    never CRITICAL.  Both jobs finish; the BSP job is bit-equal to an
+    uncontended run; the EASGD job's data trace is gap-free across the
+    shrink and its convergence clears a margin gate against an
+    uncontended same-seed run, recorded as a ledger-classifiable
+    CONVERGE.json."""
+    monkeypatch.delenv("THEANOMPI_DATA_TRACE", raising=False)
+    monkeypatch.delenv("THEANOMPI_FAULT_PLAN", raising=False)
+    fleet_dir = str(tmp_path / "fleet")
+    trace_a = str(tmp_path / "trace_a")
+    trace_b = str(tmp_path / "trace_b")
+    tel_a = str(tmp_path / "tel_a")
+    rec_dir_a = str(tmp_path / "rec_a")
+    cache_args = ["--compile-cache-dir", subproc_compile_cache]
+    easgd_model = {**TINY_CFG, "n_train": 64, "n_epochs": 5}
+    # stragglers at exchange ordinals 8-12: late enough that the stretch
+    # detector's rolling median is anchored by a majority of good rounds
+    # (episode 2's FIRST interval is an eval-warmup outlier, and each
+    # stall itself joins the window), consecutive enough to sustain the
+    # bad-round streak past async_min_rounds — and the post-stall rounds
+    # recover the verdict to ok before close.  The 0.05s health tick
+    # cannot miss the multi-second warn window the 0.6s stalls hold open.
+    spec_a = JobSpec(
+        job_id="easgd-lowpri", priority=0, min_devices=4, rule="EASGD",
+        model_config=easgd_model,
+        rule_config={"tau": 1, "scale_lr": False,
+                     "checkpoint_every_n_iters": 1,
+                     "checkpoint_async": False,
+                     "telemetry_health": {"tick_s": 0.05}},
+        env={**_child_env(), "THEANOMPI_DATA_TRACE": trace_a,
+             "THEANOMPI_EASGD_SLOW_S": "0.6",
+             "THEANOMPI_FAULT_PLAN": ",".join(
+                 f"easgd:worker_slow@{i}" for i in range(8, 13))},
+        extra_args=[*cache_args, "--telemetry-dir", tel_a,
+                    "--record-dir", rec_dir_a],
+        max_restarts=3, backoff_base=0.1)
+    spec_b = JobSpec(
+        job_id="urgent", priority=10, min_devices=4, max_devices=4,
+        model_config=dict(TINY_CFG),
+        env={**_child_env(), "THEANOMPI_DATA_TRACE": trace_b},
+        extra_args=cache_args, max_restarts=3, backoff_base=0.1)
+
+    sched = FleetScheduler(fleet_dir, 8, poll_s=0.05)
+    sched.submit(spec_a)
+    box = {}
+    t = threading.Thread(target=lambda: box.setdefault("rc", sched.run()))
+    t.start()
+    deadline = time.perf_counter() + 300
+    while time.perf_counter() < deadline and not _trace(trace_a):
+        time.sleep(0.02)
+    assert _trace(trace_a), "EASGD job never completed a step"
+    sched.submit(spec_b)
+    t.join(600)
+    assert not t.is_alive(), "fleet scheduler hung"
+    assert box["rc"] == EXIT_CLEAN
+
+    # -- lifecycle: preemption without a restart budget spent ---------------
+    rec_a = read_record(fleet_dir, "easgd-lowpri")
+    rec_b = read_record(fleet_dir, "urgent")
+    assert rec_a.status == "done" and rec_b.status == "done"
+    assert rec_a.preemptions == 1 and rec_a.episodes == 2
+    assert rec_a.preempt_exits == [EXIT_PREEMPTED]
+    story = [(e["event"], e["job"]) for e in read_fleet_events(fleet_dir)]
+    assert story[:4] == [("fleet.schedule", "easgd-lowpri"),
+                         ("fleet.preempt", "easgd-lowpri"),
+                         ("fleet.schedule", "urgent"),
+                         ("fleet.resume", "easgd-lowpri")]
+
+    # -- the contender is untouched by the chaos ----------------------------
+    ck_b_ref = str(tmp_path / "ck_bref")
+    _bsp(4, ck_b_ref).wait()
+    _assert_ckpt_equal(
+        os.path.join(job_dir(fleet_dir, "urgent"), "ckpt",
+                     "ckpt_e0001.npz"),
+        os.path.join(ck_b_ref, "ckpt_e0001.npz"))
+
+    # -- async health: stragglers WARN, never CRITICAL ----------------------
+    # each relaunched attempt truncates events-rank0.jsonl, so the final
+    # file is episode 2's — the elastic mesh4 resume that absorbed the
+    # injected stalls
+    events_path = [os.path.join(tel_a, f) for f in sorted(os.listdir(tel_a))
+                   if f.startswith("events-rank")][0]
+    events = [json.loads(line) for line in open(events_path)]
+    exchanges = [e for e in events if e.get("name") == "easgd.exchange"]
+    assert exchanges, "no exchange instants in episode 2"
+    assert any(e.get("stretch", 0) >= 2.5 for e in exchanges), \
+        "injected stalls never registered as interval stretch"
+    async_verdicts = [e for e in events
+                      if e.get("name") == "health.verdict"
+                      and e.get("detector") == "async_staleness"]
+    sevs = [v["severity"] for v in async_verdicts]
+    assert "warn" in sevs, f"straggler absorption never warned: {sevs}"
+    assert "critical" not in sevs, f"chaos escalated to critical: {sevs}"
+    health = json.load(open(os.path.join(tel_a, "HEALTH.json")))
+    by_det = {v["detector"]: v for v in health["verdicts"]}
+    assert by_det["async_staleness"]["severity"] in ("ok", "warn")
+
+    # -- gap-free trace across the shrink -----------------------------------
+    ta = _trace(trace_a)
+    k = _find_split(ta, n_train=64, gb_hi=32, gb_lo=16, n_epochs=5)
+    # episode 2 must hold >= 14 exchange rounds so the ordinal-8..12
+    # stalls all land there: 20 - 2k rounds remain after k mesh8 steps
+    assert 1 <= k <= 3, f"preemption landed outside episode 1's work: {k}"
+
+    # -- convergence gate vs an uncontended same-seed run -------------------
+    from theanompi_tpu import EASGD
+
+    ref = EASGD(config={"verbose": False, "scale_lr": False, "tau": 1})
+    ref.init(devices=8, modelfile="theanompi_tpu.models.wide_resnet",
+             modelclass="WideResNet", model_config=dict(easgd_model))
+    ref.wait()
+    ref_best = float(np.min(ref.trainer.recorder.val_history["cost"]))
+    hist = np.load(os.path.join(rec_dir_a, "val_history.npy"),
+                   allow_pickle=True).item()
+    assert list(hist["epoch"]) == [0, 1, 2, 3, 4]  # continuous curve
+    best = float(np.min(hist["cost"]))
+    target = ref_best * 1.25  # generous: tiny-data noise, not a tuning gate
+    to_target = next((int(e) for e, c in zip(hist["epoch"], hist["cost"])
+                      if c <= target), None)
+    row = {"model": "wrn_easgd_chaos", "rule": "EASGD",
+           "target_error": target, "best_val_error": best,
+           "passed": best <= target, "epochs_to_target": to_target}
+    conv_path = os.path.join(str(tmp_path), "CONVERGE.json")
+    with open(conv_path, "w") as f:
+        json.dump({"run_id": "chaos-e2e", "results": [row]}, f)
+    assert row["passed"], (
+        f"contended EASGD lost convergence: best {best:.4f} vs "
+        f"uncontended {ref_best:.4f} (target {target:.4f})")
+    # the artifact is ledger-classifiable as a higher-is-better margin
+    from theanompi_tpu.telemetry.ledger import classify_artifact
+
+    (margin_rec,) = classify_artifact(conv_path, json.load(open(conv_path)))
+    assert margin_rec["metric"] == "converge.wrn_easgd_chaos.margin"
+    assert margin_rec["value"] == pytest.approx(target - best)

@@ -411,3 +411,28 @@ def test_bench_ledger_append_disabled_and_safe(tmp_path, monkeypatch):
     blocker.write_text("")
     monkeypatch.setenv("BENCH_LEDGER", str(blocker / "l.jsonl"))
     bench_ledger_append({"metric": "m", "value": 1.0}, "s")
+
+
+def test_classify_converge_margin_records():
+    """ISSUE 20: CONVERGE.json rows become higher-is-better margin
+    records (target_error - best_val_error) the trend gate can hold a
+    chaos acceptance to; rows without both numbers are skipped."""
+    conv = {"run_id": "r20", "results": [
+        {"model": "wrn_easgd", "rule": "EASGD", "target_error": 0.50,
+         "best_val_error": 0.42, "passed": True, "epochs_to_target": 3},
+        {"model": "incomplete", "target_error": 0.5},
+        "not-a-row",
+    ]}
+    (rec,) = classify_artifact("CONVERGE.json", conv)
+    assert rec["metric"] == "converge.wrn_easgd.margin"
+    assert rec["value"] == pytest.approx(0.08)
+    assert rec["kind"] == "converge" and rec["extra"]["rule"] == "EASGD"
+    assert rec["extra"]["passed"] is True
+    assert rec["extra"]["epochs_to_target"] == 3
+    # margin trends UPWARD: a shrinking margin is the regression
+    assert not lower_is_better("converge.wrn_easgd.margin", "margin")
+    # the backfill sweep picks the artifact up
+    from theanompi_tpu.telemetry.ledger import BACKFILL_PATTERNS
+    import fnmatch
+    assert any(fnmatch.fnmatch("CONVERGE.json", p)
+               for p in BACKFILL_PATTERNS)

@@ -609,6 +609,76 @@ def test_runbook_fleet_command(tmp_path, monkeypatch, subproc_compile_cache):
     assert names.count("fleet.complete") == 2
 
 
+def test_runbook_fleet_async_command(tmp_path, monkeypatch,
+                                     subproc_compile_cache):
+    """RUNBOOK step 8b's contended-async rehearsal (ISSUE 20) at toy
+    scale: the exact `tmfleet submit --rule EASGD` flags BASELINE.md
+    documents, with a straggler injected through the documented
+    `THEANOMPI_FAULT_PLAN`/`THEANOMPI_EASGD_SLOW_S` env pair, must drive
+    the EASGD job to completion and leave the artifacts the step's
+    verdict reads: per-job telemetry with `easgd.exchange` instants and
+    a HEALTH.json whose async_staleness verdict is ok/warn, never
+    critical.  (The preemption/elastic-resume half runs at full depth in
+    test_fleet.py's chaos acceptance — this locks the CLI surface.)"""
+    import sys
+
+    from theanompi_tpu.fleet import cli as fleet_cli
+    from theanompi_tpu.fleet import read_fleet_events
+    from theanompi_tpu.fleet.jobs import read_record
+    from theanompi_tpu.telemetry.health import read_health
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=8")
+    monkeypatch.setenv("JAX_THREEFRY_PARTITIONABLE", "true")
+    monkeypatch.setenv("PYTHONPATH",
+                       repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    monkeypatch.delenv("THEANOMPI_DATA_TRACE", raising=False)
+    # the documented injection pair: one straggler at the second
+    # elastic exchange, shrunk from the runbook's 0.6 s to keep the
+    # dry-run fast (the flag surface is what this test locks)
+    monkeypatch.setenv("THEANOMPI_FAULT_PLAN", "easgd:worker_slow@1")
+    monkeypatch.setenv("THEANOMPI_EASGD_SLOW_S", "0.05")
+    assert sys.executable
+    d = str(tmp_path / "pool")
+    tel = os.path.join(d, "jobs", "nightly-easgd", "telemetry")
+    assert fleet_cli.main([
+        "submit", "--fleet-dir", d, "--job-id", "nightly-easgd",
+        "--priority", "0", "--min-devices", "4", "--max-devices", "4",
+        "--rule", "EASGD", "--rule-set", "tau=1",
+        "--rule-set", "scale_lr=False",
+        "--rule-set", "checkpoint_every_n_iters=1",
+        "--rule-set", "telemetry_health={'tick_s': 0.05}",
+        "--set", "depth=10", "--set", "widen=1", "--set", "batch_size=4",
+        "--set", "image_size=8", "--set", "n_train=32",
+        "--set", "n_val=16", "--set", "n_epochs=1",
+        "--set", "precision='fp32'",
+        "--max-restarts", "3", "--backoff-base", "0.1",
+        f"--extra-arg=--compile-cache-dir={subproc_compile_cache}",
+        f"--extra-arg=--telemetry-dir={tel}"]) == 0
+    assert fleet_cli.main(["run", "--fleet-dir", d, "--pool-size", "8",
+                           "--quiet"]) == 0
+    rec = read_record(d, "nightly-easgd")
+    assert rec.status == "done" and rec.spec.rule == "EASGD"
+    names = [e["event"] for e in read_fleet_events(d)]
+    assert names.count("fleet.schedule") == 1
+    assert names.count("fleet.complete") == 1
+    # the per-job telemetry the step-8b verdict reads
+    ev_files = [f for f in sorted(os.listdir(tel))
+                if f.startswith("events-rank")]
+    assert ev_files
+    events = [json.loads(ln)
+              for ln in open(os.path.join(tel, ev_files[0]))]
+    rounds = [e for e in events if e.get("name") == "easgd.exchange"]
+    assert rounds  # tau=1, 2 steps/epoch -> 2 exchange instants
+    assert all("staleness" in e and "stretch" in e for e in rounds)
+    health = read_health(tel)
+    assert health is not None
+    sevs = {v["detector"]: v["severity"] for v in health["verdicts"]}
+    assert sevs.get("async_staleness", "ok") in ("ok", "warn")
+
+
 def test_runbook_tmprof_command(tmp_path, capsys):
     """BASELINE step 9 (ISSUE 16): the exact `tmprof ./telemetry` and
     `tmprof --ledger update/check` invocations.  The attribution table

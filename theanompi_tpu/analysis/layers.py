@@ -88,6 +88,11 @@ LAYER_DAG: tuple[tuple[str, tuple[str, ...], tuple[str, ...]], ...] = (
     ("ckpt",       (f"{PKG}.utils.checkpoint",),
                    ("syncpoint", "codes", "telemetry", "resilience",
                     "utils_base")),
+    # the async rules (parallel.easgd / parallel.gosgd, ISSUE 20) live
+    # in this layer: their host-side state (round ordinals, gossip draws
+    # via models.data.base.derive_seed, fault-plan hooks) imports only
+    # downward — and they stay forbidden any-depth in the serving/fleet/
+    # router walls below like the rest of the training machinery
     ("training",   (f"{PKG}.parallel",),
                    ("codes", "telemetry", "resilience", "mesh", "kernels",
                     "sharding", "ops", "utils_base", "exchange", "data",

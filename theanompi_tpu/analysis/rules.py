@@ -755,6 +755,11 @@ REGISTERED_NAME_PREFIXES = (
     "theanompi_tpu/telemetry/profile.py",
     "theanompi_tpu/telemetry/ledger.py",
     "theanompi_tpu/telemetry/prof.py",
+    # ISSUE 20: the async rules' per-round instants feed the
+    # async_staleness detector — their easgd.*/gosgd.*/exchange.* names
+    # bind from metrics.py (ASYNC_INSTANTS/ASYNC_GAUGES/EXCHANGE_COUNTS)
+    "theanompi_tpu/parallel/easgd.py",
+    "theanompi_tpu/parallel/gosgd.py",
 )
 
 #: emission entry points whose FIRST positional argument is an event name

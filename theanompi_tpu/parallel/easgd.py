@@ -27,6 +27,11 @@ synchronous rounds rather than per-worker-clock asynchronous events.
 
 from __future__ import annotations
 
+import os
+import sys
+import time
+from collections import deque
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -44,7 +49,18 @@ from theanompi_tpu.parallel.trainer import (
     stack_for_workers,
     unstack,
 )
+from theanompi_tpu.telemetry.metrics import (
+    ASYNC_GAUGES,
+    ASYNC_INSTANTS,
+    EXCHANGE_COUNTS,
+)
 from theanompi_tpu.utils.helper_funcs import replicate
+
+# registered spellings (telemetry/metrics.py is the one source of truth
+# the async_staleness detector, tmhealth and the aggregator read from)
+_EXCHANGE_INSTANT = ASYNC_INSTANTS[0]                   # easgd.exchange
+_STALENESS_GAUGE, _DRIFT_GAUGE = ASYNC_GAUGES[0], ASYNC_GAUGES[1]
+_WIRE_BYTES = EXCHANGE_COUNTS[0]
 
 
 def elastic_exchange(params, center, alpha, axis_name=DATA_AXIS):
@@ -64,6 +80,22 @@ def elastic_exchange(params, center, alpha, axis_name=DATA_AXIS):
     return new_p, new_c
 
 
+def worker_drift(params, center):
+    """This worker's relative divergence from the center,
+    ``norm(p - c) / norm(c)`` over the float leaves (pure, inside
+    shard_map).  The ISSUE 20 health signal: computed on device at
+    exchange boundaries only — between rounds it costs nothing."""
+    num = jnp.float32(0.0)
+    den = jnp.float32(0.0)
+    for p, c in zip(jax.tree.leaves(params), jax.tree.leaves(center)):
+        if not jnp.issubdtype(jnp.asarray(p).dtype, jnp.inexact):
+            continue
+        d = p.astype(jnp.float32) - c.astype(jnp.float32)
+        num += jnp.sum(d * d)
+        den += jnp.sum(c.astype(jnp.float32) ** 2)
+    return jnp.sqrt(num) / jnp.maximum(jnp.sqrt(den), 1e-12)
+
+
 class EASGDTrainer(BaseTrainer):
     """τ local steps per worker, then a collective elastic exchange.
 
@@ -79,11 +111,22 @@ class EASGDTrainer(BaseTrainer):
         super().__init__(model, mesh=mesh, **kwargs)
         require_data_parallel_mesh(self.mesh, "EASGDTrainer")
         self.tau = tau
+        # keep the CONFIGURED value apart from the derived one: the
+        # fingerprint stamps the config ("auto" when defaulted), so the
+        # n-dependent default never pins a lineage to one worker count
+        self._alpha_cfg = alpha
         self.alpha = alpha if alpha is not None else 0.9 / self.n_workers
         self.center = None
         self._exchange_fn = None
         self._consensus_state_fn = None
         self._elastic_wire_bytes: int | None = None
+        # ISSUE 20 round bookkeeping: ordinal (the easgd fault-site index),
+        # staleness anchor, and a wall-interval window for the stretch
+        # signal the async_staleness detector consumes
+        self._exchange_count = 0
+        self._last_exchange_iter = 0
+        self._exchange_intervals: deque = deque(maxlen=16)
+        self._last_exchange_t: float | None = None
 
     def _exchange_pair(self, params, center):
         """The periodic exchange, on UNSTACKED per-worker params; the
@@ -103,7 +146,11 @@ class EASGDTrainer(BaseTrainer):
         local_eval = make_local_eval(self.model)
 
         def exchange(params, center):
-            return self._exchange_pair(params, center)
+            # drift against the PRE-round center: the divergence the τ
+            # local steps accumulated, which this round is about to relax
+            drift = worker_drift(unstack(params), center)
+            new_p, new_c = self._exchange_pair(params, center)
+            return new_p, new_c, drift[None]
 
         def consensus_state(state):
             return pmean_floats(unstack(state), DATA_AXIS)
@@ -119,7 +166,8 @@ class EASGDTrainer(BaseTrainer):
             donate_argnums=(0, 1, 2),
         )
         self._exchange_fn = jax.jit(
-            shard_map(exchange, self.mesh, in_specs=(W, P()), out_specs=(W, P())),
+            shard_map(exchange, self.mesh, in_specs=(W, P()),
+                      out_specs=(W, P(), W)),
             donate_argnums=(0, 1),
         )
         self._eval_fn = jax.jit(
@@ -140,27 +188,69 @@ class EASGDTrainer(BaseTrainer):
         self.center = replicate(self.mesh, params)
 
     def post_step(self) -> None:
-        if self.iteration % self.tau == 0:
-            self.recorder.start("comm")
-            self.params, self.center = self._exchange_fn(self.params, self.center)
-            self.recorder.end("comm")
-            if self.telemetry is not None:
-                # iteration was already advanced by train_iter: the
-                # exchange belongs to the step just finished, whose
-                # train.step span is tagged with the pre-increment index
-                self.telemetry.count(
-                    "exchange.wire_bytes", self._periodic_wire_bytes(),
-                    emit=True, step=self.iteration - 1)
+        if self.iteration % self.tau != 0:
+            return
+        ordinal = self._exchange_count
+        self._exchange_count += 1
+        if self.fault_plan is not None \
+                and self.fault_plan.fire("easgd", ordinal, "worker_slow"):
+            # ISSUE 20 straggler site: stall the host before the collective
+            # — the synchronous round waits, so throughput degrades while
+            # the exchange math (and therefore the trajectory) is untouched
+            slow_s = float(os.environ.get("THEANOMPI_EASGD_SLOW_S", "0.5"))
+            print(f"faults: injected EASGD straggler: round {ordinal} "
+                  f"stalls {slow_s:g}s", file=sys.stderr, flush=True)
+            time.sleep(slow_s)
+        self.recorder.start("comm")
+        self.params, self.center, drift = self._exchange_fn(
+            self.params, self.center)
+        self.recorder.end("comm")
+        staleness = self.iteration - self._last_exchange_iter
+        self._last_exchange_iter = self.iteration
+        now = time.perf_counter()
+        stretch = 0.0
+        if self._last_exchange_t is not None:
+            interval = now - self._last_exchange_t
+            if self._exchange_intervals:
+                base = sorted(self._exchange_intervals)[
+                    len(self._exchange_intervals) // 2]
+                if base > 0:
+                    stretch = interval / base
+            self._exchange_intervals.append(interval)
+        self._last_exchange_t = now
+        if self.telemetry is not None:
+            # iteration was already advanced by train_iter: the
+            # exchange belongs to the step just finished, whose
+            # train.step span is tagged with the pre-increment index
+            self.telemetry.count(
+                _WIRE_BYTES, self._periodic_wire_bytes(),
+                emit=True, step=self.iteration - 1)
+            # the scalar pull syncs on the round's outputs — once per
+            # round, never per step
+            drift_max = float(jnp.max(drift))
+            self.telemetry.instant(
+                _EXCHANGE_INSTANT, step=self.iteration - 1,
+                staleness=int(staleness), expected=int(self.tau),
+                stretch=round(stretch, 3), drift=round(drift_max, 6))
+            self.telemetry.metrics.gauge(_STALENESS_GAUGE, staleness)
+            self.telemetry.metrics.gauge(_DRIFT_GAUGE, drift_max)
 
     def _periodic_wire_bytes(self) -> int:
         """Static ICI accounting for one elastic round: the only collective
-        is the fp32 ``psum(p - c)`` over one params-sized tree (see
-        :func:`elastic_exchange`) — ring traffic of that buffer."""
+        is the ``psum(p - c)`` over one params-sized tree (see
+        :func:`elastic_exchange`) — ring traffic of that buffer.  Payload
+        sizing goes through the ISSUE 2 per-dtype contract
+        (:func:`~theanompi_tpu.parallel.exchanger.wire_itemsize`): the
+        elastic psum moves ``p - c`` in each leaf's OWN dtype — no bf16/int8
+        wire compression — so every float leaf counts verbatim."""
         if self._elastic_wire_bytes is None:
-            from theanompi_tpu.parallel.exchanger import collective_wire_bytes
+            from theanompi_tpu.parallel.exchanger import (
+                collective_wire_bytes,
+                wire_itemsize,
+            )
 
             total = sum(
-                leaf.size * leaf.dtype.itemsize
+                leaf.size * wire_itemsize("elastic", leaf.dtype)
                 for leaf in jax.tree.leaves(self.center)
                 if jnp.issubdtype(leaf.dtype, jnp.inexact)
             )
@@ -169,7 +259,8 @@ class EASGDTrainer(BaseTrainer):
         return self._elastic_wire_bytes
 
     def warmup_exchange(self) -> None:
-        self.params, self.center = self._exchange_fn(self.params, self.center)
+        self.params, self.center, _ = self._exchange_fn(
+            self.params, self.center)
 
     def eval_args(self):
         """Validate with the center parameters (the reference server's job)."""
@@ -177,6 +268,20 @@ class EASGDTrainer(BaseTrainer):
 
     def checkpoint_trees(self) -> dict:
         return {**super().checkpoint_trees(), "center": self.center}
+
+    def _fingerprint_extra(self) -> dict:
+        """ISSUE 20: rule-typed manifest stamp.  ``rule`` is the stacked
+        LAYOUT tag (the reshard planner keys its per-worker re-layout on
+        it; the trainer class itself already rides the ``exchange`` key);
+        ``alpha`` is the CONFIGURED value — ``"auto"`` stays ``"auto"``
+        across an elastic mesh8->4 resume, while an explicitly pinned
+        alpha (like tau) refuses to silently change mid-lineage."""
+        return {
+            "rule": "easgd",
+            "tau": int(self.tau),
+            "alpha": ("auto" if self._alpha_cfg is None
+                      else float(self._alpha_cfg)),
+        }
 
 
 class LocalSGDTrainer(EASGDTrainer):

@@ -31,6 +31,8 @@ jointly-iid — the per-worker target distribution is unchanged.
 
 from __future__ import annotations
 
+import sys
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -38,6 +40,11 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from theanompi_tpu.parallel.mesh import DATA_AXIS, shard_map
+from theanompi_tpu.telemetry.metrics import (
+    ASYNC_GAUGES,
+    ASYNC_INSTANTS,
+    EXCHANGE_COUNTS,
+)
 from theanompi_tpu.parallel.trainer import (
     BaseTrainer,
     Rule,
@@ -49,6 +56,12 @@ from theanompi_tpu.parallel.trainer import (
     stack_for_workers,
     unstack,
 )
+
+# registered spellings (telemetry/metrics.py is the one source of truth
+# the async_staleness detector, tmhealth and the aggregator read from)
+_ROUND_INSTANT = ASYNC_INSTANTS[1]                      # gosgd.round
+_STALE_MAX_GAUGE, _STALE_MEAN_GAUGE = ASYNC_GAUGES[2], ASYNC_GAUGES[3]
+_WIRE_BYTES = EXCHANGE_COUNTS[0]
 
 
 def gossip_merge(params, weight, push, shift, n, axis_name=DATA_AXIS):
@@ -102,27 +115,58 @@ class GOSGDTrainer(BaseTrainer):
     def __init__(self, model, mesh=None, p_push: float | None = None, **kwargs):
         super().__init__(model, mesh=mesh, **kwargs)
         require_data_parallel_mesh(self.mesh, "GOSGDTrainer")
+        # configured vs derived, same split as EASGD's alpha: the
+        # fingerprint stamps the config ("auto" when defaulted), keeping
+        # the n-dependent default reshard-compatible
+        self._p_push_cfg = p_push
         self.p_push = p_push if p_push is not None else 1.0 / max(self.n_workers, 2)
         self.weights = None
         self._gossip_fn = None
         self._consensus_fn = None
-        # seeded in init_state so warmup()'s reset restores the full
-        # deterministic schedule (push draws + ring shifts), not just params
-        self._host_rng = None
         self._hop_bytes: int | None = None
+        # ISSUE 20 round bookkeeping: round ordinal (the gosgd fault-site
+        # index) and the per-worker last-participation anchor behind the
+        # staleness gauges (lazily re-anchored so a resume never reads as
+        # a staleness spike)
+        self._round_count = 0
+        self._last_touch: np.ndarray | None = None
 
     def _gossip_hop_bytes(self) -> int:
         """Per-device fp32 bytes one gossip hop moves: the float leaves of
         ONE worker's params (the stacked tree's leading axis is the worker
-        count) plus the scalar consensus weight, all cast to fp32 on the
-        wire by gossip_merge."""
+        count) plus the scalar consensus weight.
+
+        Audited against the ISSUE 2 per-dtype contract
+        (:func:`~theanompi_tpu.parallel.exchanger.wire_itemsize`):
+        :func:`gossip_merge` explicitly casts every outgoing leaf to fp32
+        (``sent_w * leaf.astype(float32)``), so — unlike the bf16/int8 BSP
+        strategies — the wire itemsize is 4 for EVERY float leaf, whatever
+        its storage dtype; non-float leaves never travel."""
         if self._hop_bytes is None:
-            total = 4  # the ppermuted consensus-weight scalar
+            fp32_wire = np.dtype(np.float32).itemsize
+            total = fp32_wire  # the ppermuted consensus-weight scalar
             for leaf in jax.tree.leaves(self.params):
                 if jnp.issubdtype(leaf.dtype, jnp.inexact):
-                    total += leaf.size // self.n_workers * 4
+                    total += leaf.size // self.n_workers * fp32_wire
             self._hop_bytes = total
         return self._hop_bytes
+
+    def _round_draws(self, iteration: int):
+        """The (push mask, ring shift) of round ``iteration`` — a pure
+        function of (seed, iteration) through the repo's one
+        seed-derivation helper, NOT a stateful host RNG: a SIGKILL resume
+        at iteration k replays exactly the draws the uninterrupted run
+        would have made, so resume bit-equality holds with no extra
+        checkpoint state (the old ``RandomState`` carried hidden state no
+        checkpoint captured)."""
+        from theanompi_tpu.models.data.base import derive_seed
+
+        n = self.n_workers
+        rng = np.random.RandomState(
+            derive_seed("gossip", self.seed, iteration))
+        push = (rng.rand(n) < self.p_push).astype(np.float32)
+        shift = int(rng.randint(1, n))
+        return push, shift
 
     def compile_iter_fns(self) -> None:
         local_step = make_local_step(
@@ -189,34 +233,68 @@ class GOSGDTrainer(BaseTrainer):
         self.weights = jax.device_put(
             np.full((n,), 1.0 / n, np.float32), NamedSharding(self.mesh, P(DATA_AXIS))
         )
-        self._host_rng = np.random.RandomState(self.seed + 17)
+        self._round_count = 0
+        self._last_touch = None
 
     def post_step(self) -> None:
         n = self.n_workers
         if n == 1:
             return
-        push = (self._host_rng.rand(n) < self.p_push).astype(np.float32)
+        if self._last_touch is None:
+            # lazy anchor: init_state runs BEFORE try_resume restores the
+            # iteration counter, so anchoring here (first round of this
+            # process) keeps post-resume staleness honest
+            self._last_touch = np.full((n,), self.iteration - 1, np.int64)
+        push, shift = self._round_draws(self.iteration)
         if not push.any():
             return  # no sender drawn this round — skip the collective
-        # random ring shift: every pusher's target is uniform over its peers
-        shift = self._host_rng.randint(1, n)
-        self.recorder.start("comm")
-        self.params, self.weights = self._gossip_fn(
-            self.params,
-            self.weights,
-            jnp.asarray(push),
-            jnp.int32(shift),
-        )
-        self.recorder.end("comm")
+        ordinal = self._round_count
+        self._round_count += 1
+        dropped = (self.fault_plan is not None
+                   and self.fault_plan.fire("gosgd", ordinal,
+                                            "gossip_drop") is not None)
+        if dropped:
+            # ISSUE 20 degradation site: the round's collective is skipped
+            # — the draws above were already consumed, so the schedule of
+            # every later round is unchanged; consensus weights still sum
+            # to 1 (nothing moved) and only staleness grows
+            print(f"faults: injected gossip drop: round {ordinal} "
+                  f"(shift {shift}) skipped", file=sys.stderr, flush=True)
+        else:
+            self.recorder.start("comm")
+            self.params, self.weights = self._gossip_fn(
+                self.params,
+                self.weights,
+                jnp.asarray(push),
+                jnp.int32(shift),
+            )
+            self.recorder.end("comm")
+            # a round touches its pushers and their ring targets; everyone
+            # else ages — the per-worker staleness the detector watches
+            pushers = np.flatnonzero(push > 0)
+            self._last_touch[pushers] = self.iteration
+            self._last_touch[(pushers + shift) % n] = self.iteration
         if self.telemetry is not None:
-            # gossip_merge ppermutes the full fp32 float-param set of ONE
-            # worker on every device for each of the `shift` hops (the push
-            # mask zeroes values, not traffic), so the round's per-device
-            # wire bytes are statically shift * tree_bytes; step index is
-            # pre-increment, matching the train.step span (see EASGD)
-            self.telemetry.count(
-                "exchange.wire_bytes", shift * self._gossip_hop_bytes(),
-                emit=True, step=self.iteration - 1, shift=int(shift))
+            if not dropped:
+                # gossip_merge ppermutes the full fp32 float-param set of
+                # ONE worker on every device for each of the `shift` hops
+                # (the push mask zeroes values, not traffic), so the
+                # round's per-device wire bytes are statically
+                # shift * tree_bytes; step index is pre-increment,
+                # matching the train.step span (see EASGD)
+                self.telemetry.count(
+                    _WIRE_BYTES, shift * self._gossip_hop_bytes(),
+                    emit=True, step=self.iteration - 1, shift=int(shift))
+            staleness = self.iteration - self._last_touch
+            self.telemetry.instant(
+                _ROUND_INSTANT, step=self.iteration - 1,
+                staleness=int(staleness.max()),
+                expected=round(1.0 / self.p_push, 3),
+                shift=int(shift), dropped=bool(dropped))
+            self.telemetry.metrics.gauge(_STALE_MAX_GAUGE,
+                                         int(staleness.max()))
+            self.telemetry.metrics.gauge(_STALE_MEAN_GAUGE,
+                                         float(staleness.mean()))
 
     def warmup_exchange(self) -> None:
         if self.n_workers == 1:
@@ -234,6 +312,17 @@ class GOSGDTrainer(BaseTrainer):
 
     def checkpoint_trees(self) -> dict:
         return {**super().checkpoint_trees(), "weights": self.weights}
+
+    def _fingerprint_extra(self) -> dict:
+        """ISSUE 20 rule-typed manifest stamp (see EASGD's for the layout
+        tag / configured-value rationale; the gossip shift needs no stamp
+        — it is a pure function of (seed, iteration), both already in the
+        manifest)."""
+        return {
+            "rule": "gosgd",
+            "p_push": ("auto" if self._p_push_cfg is None
+                       else float(self._p_push_cfg)),
+        }
 
 
 class GOSGD(Rule):

@@ -704,12 +704,16 @@ class BaseTrainer:
             # run and say so loudly (a silently rescaled LR would read as
             # a lineage bug)
             self.lr_scale = plan.lr_scale
+            lr_note = (
+                "LR carried unrescaled (async rule: per-worker batch and "
+                "update are n-independent)"
+                if getattr(plan, "stacked", None) is not None
+                else f"LR scaled x{plan.lr_scale:g} (linear-scaling rule)")
             print(f"trainer: RESHARD resumed a {plan.old_n}-worker "
                   f"checkpoint onto {self.n_workers} workers: global batch "
                   f"{self.model.batch_size * plan.old_n} -> "
-                  f"{self.global_batch} (per-worker batch fixed), LR "
-                  f"scaled x{plan.lr_scale:g} (linear-scaling rule)",
-                  file=sys.stderr, flush=True)
+                  f"{self.global_batch} (per-worker batch fixed), "
+                  f"{lr_note}", file=sys.stderr, flush=True)
         else:
             # a plain resume of a previously-resharded lineage keeps its
             # cumulative LR factor (stamped in the manifest)

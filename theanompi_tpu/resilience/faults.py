@@ -58,12 +58,22 @@ specs separated by ``;`` or ``,``)::
                          before the watcher verifies it — the rollout
                          must refuse the candidate and keep serving the
                          old weights (candidate ordinal, not decode step)
+    easgd:worker_slow@2  ISSUE 20: sleep THEANOMPI_EASGD_SLOW_S seconds
+                         (default 0.5) before the elastic exchange of
+                         round ordinal 2 — a straggler stalling the
+                         synchronous round: throughput degrades, the
+                         exchange math is untouched
+    gosgd:gossip_drop@2  the gossip round of ordinal 2 (rounds where a
+                         push was drawn) skips its collective — the host
+                         draws are still consumed, so the round schedule
+                         stays aligned and only worker staleness grows
 
 ``INDEX`` is the global step for ``step``, the batch ordinal for
 ``prefetch``, the per-process read ordinal for ``data`` (every
 ``read_with_retry`` call draws the next ordinal; ``set_data_hooks``
 resets the counter), the epoch for ``checkpoint``, the supervisor
-attempt for ``reshard``, the launch/persist ordinal for ``fleet``, and
+attempt for ``reshard``, the launch/persist ordinal for ``fleet``, the
+exchange/gossip round ordinal for ``easgd``/``gosgd``, and
 for ``serve`` the decode-step ordinal (``raise``/``stall``) or the
 rollout-candidate ordinal (``rollout_corrupt`` — the two hooks count
 different things, so the scheduler and the rollout watcher both narrow
@@ -102,6 +112,8 @@ SITES = {
     "reshard": ("fail",),
     "fleet": ("kill_job", "ledger_torn_write"),
     "serve": ("raise", "stall", "rollout_corrupt"),
+    "easgd": ("worker_slow",),
+    "gosgd": ("gossip_drop",),
 }
 
 

@@ -30,6 +30,14 @@ the same event stream into *in-flight* typed verdicts:
   ``slo_critical_factor`` x the SLO the verdict turns *critical*, which
   the serving rollout watcher's probation window treats as the
   roll-back-now signal (ISSUE 14).
+- ``async_staleness`` — the async rules' graceful-degradation witness
+  (ISSUE 20), fed by the per-round ``easgd.exchange`` / ``gosgd.round``
+  instants: *warn* when staleness skews past the rule's expected cadence
+  or the exchange wall-interval stretches past the rolling median for
+  ``async_min_rounds`` consecutive rounds (a straggler the rule is
+  absorbing — degraded, not broken); *critical* only when the relative
+  center drift blows past ``async_drift_critical`` (the elastic coupling
+  is no longer bounding divergence — correctness, not throughput).
 
 Verdicts are written atomically to ``HEALTH.json`` in the telemetry
 directory by the owning :class:`~theanompi_tpu.telemetry.core.Telemetry`'s
@@ -105,6 +113,16 @@ class HealthConfig:
     #: detector costs one ``stat`` per tick.
     perf_ledger_path: str | None = None
     perf_tolerance: float = 0.10
+    #: ISSUE 20 async_staleness thresholds: a round is BAD when its
+    #: staleness reaches ``async_staleness_factor`` x the rule's expected
+    #: cadence, or its wall interval ``async_stretch_factor`` x the
+    #: rolling median of previous rounds; ``async_min_rounds`` consecutive
+    #: bad rounds make a warn.  Drift at/past ``async_drift_critical``
+    #: (relative ``||p_i - center|| / ||center||``) is critical outright.
+    async_staleness_factor: float = 3.0
+    async_stretch_factor: float = 2.5
+    async_min_rounds: int = 2
+    async_drift_critical: float = 5.0
 
 
 def _median(xs) -> float:
@@ -152,6 +170,8 @@ class HealthMonitor:
         # perf-ledger state (ISSUE 16): mtime cache so an unchanged
         # ledger costs one stat per tick, not a reparse
         self._perf_mtime: float | None = None
+        # async-rule state (ISSUE 20): consecutive bad-round streak
+        self._async_bad_rounds = 0
 
     # -- ingestion -----------------------------------------------------------
     def observe(self, event: dict, now: float | None = None) -> None:
@@ -172,6 +192,9 @@ class HealthMonitor:
                     self._boundary_depth = max(0, self._boundary_depth - 1)
             elif kind == "span" and name == "train.step":
                 self._observe_step(event)
+            elif kind == "instant" and name in ("easgd.exchange",
+                                                "gosgd.round"):
+                self._observe_async(event)
             elif name is not None and str(name).startswith("checkpoint."):
                 self._last_ckpt = now
                 self._steps_at_ckpt = self._steps
@@ -219,6 +242,56 @@ class HealthMonitor:
                               "slo_ms": cfg.slo_ttft_p99_ms})
         else:
             self._set("slo", SEV_OK, "serve.ttft_ms p99 within SLO")
+
+    def _observe_async(self, event: dict) -> None:
+        """ISSUE 20: one ``easgd.exchange`` / ``gosgd.round`` instant per
+        exchange round.  Severity contract (the chaos acceptance leans on
+        it): a straggler the rule absorbs is at most a WARN — sustained
+        staleness skew or interval stretch says "degraded, still
+        converging"; only a center-drift blow-up (the elastic coupling no
+        longer bounds divergence, a correctness signal) is CRITICAL."""
+        cfg = self.config
+        step = event.get("step")
+        step = int(step) if step is not None else self._last_step
+        name = event.get("name")
+        drift = event.get("drift")
+        if drift is not None and float(drift) >= cfg.async_drift_critical:
+            self._set("async_staleness", SEV_CRITICAL,
+                      f"center drift {float(drift):.3g} at/past "
+                      f"{cfg.async_drift_critical:g} — the elastic "
+                      f"coupling is not bounding worker divergence",
+                      step=step,
+                      fields={"drift": round(float(drift), 6),
+                              "critical_at": cfg.async_drift_critical})
+            return
+        staleness = float(event.get("staleness", 0.0) or 0.0)
+        expected = max(float(event.get("expected", 1.0) or 1.0), 1.0)
+        stretch = float(event.get("stretch", 0.0) or 0.0)
+        stale_skew = staleness >= expected * cfg.async_staleness_factor
+        stretched = stretch >= cfg.async_stretch_factor
+        if stale_skew or stretched:
+            self._async_bad_rounds += 1
+        else:
+            self._async_bad_rounds = 0
+        fields = {"staleness": staleness, "expected": expected,
+                  "stretch": round(stretch, 3),
+                  "bad_rounds": self._async_bad_rounds}
+        if drift is not None:
+            fields["drift"] = round(float(drift), 6)
+        if self._async_bad_rounds >= cfg.async_min_rounds:
+            why = (f"staleness {staleness:g} is >= "
+                   f"{cfg.async_staleness_factor:g}x the expected "
+                   f"cadence {expected:g}" if stale_skew else
+                   f"exchange interval stretched {stretch:.2f}x the "
+                   f"rolling median")
+            self._set("async_staleness", SEV_WARN,
+                      f"{name}: {why} for {self._async_bad_rounds} "
+                      f"consecutive round(s) — straggler being absorbed",
+                      step=step, fields=fields)
+        else:
+            self._set("async_staleness", SEV_OK,
+                      "async exchange cadence healthy", step=step,
+                      fields=fields)
 
     # -- detectors -----------------------------------------------------------
     def _eval_straggler(self) -> None:

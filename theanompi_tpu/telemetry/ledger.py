@@ -48,7 +48,7 @@ BACKFILL_PATTERNS = ("BENCH_r*.json", "BENCH_mfu_ladder.json",
                      "BENCH_transformer.json", "BENCH_unavailable.json",
                      "SCALING*.json", "EXCHANGE*.json", "SERVE*.json",
                      "ROUTER*.json",
-                     "ROOFLINE*.json", "ATTRIB.json")
+                     "ROOFLINE*.json", "ATTRIB.json", "CONVERGE*.json")
 
 #: unit substrings that mean lower-is-better; everything else (rates,
 #: mfu, efficiency, shares) improves upward
@@ -200,6 +200,29 @@ def classify_artifact(name: str, payload: dict) -> list[dict]:
             recs.append(make_record(base, "router", "router.replicas_peak",
                                     payload["replicas_peak"], "replicas",
                                     run_id=run_id))
+        return recs
+    # CONVERGE.json: utils/converge.py gate report (ISSUE 20 trending).
+    # Each row's margin (target_error - best_val_error) enters the
+    # trajectory as a higher-is-better point, so a rule that still
+    # passes but with shrinking headroom shows up in check() before it
+    # ever fails the gate.  Async rules (EASGD/GOSGD) ride the same
+    # branch — the rule name is carried in extra for filtering.
+    if base.startswith("CONVERGE") and isinstance(
+            payload.get("results"), list):
+        recs = []
+        for row in payload["results"]:
+            if not isinstance(row, dict):
+                continue
+            target = row.get("target_error")
+            best = row.get("best_val_error")
+            if target is None or best is None:
+                continue
+            name_key = row.get("model", "model")
+            recs.append(make_record(
+                base, "converge", f"converge.{name_key}.margin",
+                float(target) - float(best), "margin", run_id=run_id,
+                rule=row.get("rule"), passed=row.get("passed"),
+                epochs_to_target=row.get("epochs_to_target")))
         return recs
     # BENCH_transformer.json / a bare bench line
     if "metric" in payload and "value" in payload:

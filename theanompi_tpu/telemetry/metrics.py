@@ -221,6 +221,28 @@ EXCHANGE_SPANS = ("exchange.overlap",)
 #: ``exchange.accounting`` instant so wire-byte accounting tracks the phase.
 EXCHANGE_GAUGES = ("exchange.ramp_phase",)
 EXCHANGE_INSTANTS = ("exchange.ramp_switch",)
+#: per-round ICI payload counter (tags: step, and ``shift`` for gossip
+#: rounds) — the static accounting every exchange-bearing trainer emits
+EXCHANGE_COUNTS = ("exchange.wire_bytes",)
+
+# -- async-rule names (ISSUE 20) ----------------------------------------------
+# The straggler-tolerant rules emit ONE instant per exchange/gossip round
+# through these registered names ONLY (same one-source-of-truth contract as
+# every family above), carrying the fields the ``async_staleness`` health
+# detector consumes.  ``easgd.exchange`` (tags: step, staleness — steps
+# since the previous elastic round, expected — tau, stretch — wall interval
+# of this round vs the rolling median of previous rounds, drift — worst
+# per-worker ``max_i(norm(p_i - center)/norm(center))`` computed ON DEVICE
+# inside the compiled exchange, so it costs nothing between rounds);
+# ``gosgd.round`` (tags: step, staleness — the max over workers of steps
+# since each last participated in a push, expected — 1/p_push, shift,
+# dropped — an injected ``gosgd:gossip_drop`` skipped the collective).
+ASYNC_INSTANTS = ("easgd.exchange", "gosgd.round")
+#: flush-boundary gauges mirroring the newest round's fields: per-worker
+#: staleness (EASGD rounds are mutually synchronous, so one number; GOSGD
+#: gauges the max and mean over workers) and EASGD's relative center drift
+ASYNC_GAUGES = ("easgd.staleness", "easgd.center_drift",
+                "gosgd.staleness_max", "gosgd.staleness_mean")
 
 # -- step-attribution names (ISSUE 16) ----------------------------------------
 # The StepAttributor (``telemetry/profile.py``) publishes per-segment

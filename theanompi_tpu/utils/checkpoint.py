@@ -462,6 +462,13 @@ def _manifest_leaves(manifest: dict, tree: str) -> list[tuple[str, dict]]:
 
 _OPT_BUCKET_RE = re.compile(r"^opt_state::(.+)/(\d+)$")
 
+#: ISSUE 20: rule tag (the ``rule`` fingerprint key the async trainers
+#: stamp) -> the extra checkpoint trees that rule's stacked layout carries.
+#: ``easgd`` covers LocalSGD too (identical layout: stacked
+#: params/state/opt_state + a replicated center); ``gosgd`` adds the
+#: ``(n,)`` consensus-weight vector instead.
+_ASYNC_RULE_EXTRAS = {"easgd": ("center",), "gosgd": ("weights",)}
+
 
 @dataclasses.dataclass
 class ReshardPlan:
@@ -483,6 +490,10 @@ class ReshardPlan:
     #: flat-bucket optimizer shards; None when no flat-bucket state rides
     buckets: list[tuple[int, int, int]] | None
     warnings: list[str]
+    #: ISSUE 20: the async-rule layout tag (``"easgd"`` / ``"gosgd"``) when
+    #: params/state/opt_state carry a stacked per-worker leading axis to be
+    #: re-laid-out worker-wise; None for the data-parallel BSP layout
+    stacked: str | None = None
 
     def summary(self) -> dict:
         out = {"old_n": self.old_n, "new_n": self.new_n,
@@ -490,6 +501,8 @@ class ReshardPlan:
                "lr_scale": round(self.lr_scale, 6)}
         if self.buckets is not None:
             out["n_buckets"] = len(self.buckets)
+        if self.stacked is not None:
+            out["stacked"] = self.stacked
         return out
 
     def describe(self) -> str:
@@ -498,6 +511,15 @@ class ReshardPlan:
         lines = [f"reshard plan: {self.old_n} -> {self.new_n} workers "
                  f"(exchange {self.strategy_old} -> {self.strategy_new}, "
                  f"LR x{self.lr_scale:g})"]
+        if self.stacked is not None and self.old_n != self.new_n:
+            verb = ("keep the first"
+                    if self.new_n < self.old_n else "clone cyclically to")
+            lines.append(
+                f"  stacked per-worker trees ({self.stacked}): {verb} "
+                f"{self.new_n} worker replica(s)"
+                + ("; center restored as-is (replicated, n-independent)"
+                   if self.stacked == "easgd"
+                   else "; consensus weights renormalized to sum 1"))
         if self.buckets is not None:
             lines.append(
                 f"  zero1 flat buckets ({len(self.buckets)}): re-scatter "
@@ -516,32 +538,79 @@ class ReshardPlan:
         zero1 flat-bucket optimizer shards lose the old tail padding and
         gain the new (padding is zeros by construction — ``_pack`` pads
         gradient and param buckets with zeros, and every update rule is
-        elementwise, so the padded tail provably stays zero)."""
-        if self.buckets is None:
-            return arrays
+        elementwise, so the padded tail provably stays zero); stacked
+        async-rule trees (ISSUE 20) are re-laid-out worker-wise along
+        their leading axis (see :meth:`_transform_stacked`)."""
+        if self.buckets is None and (
+                self.stacked is None or self.old_n == self.new_n):
+            return arrays  # identity plan: no re-layout, no copy
         out = dict(arrays)
-        for key, arr in arrays.items():
-            m = _OPT_BUCKET_RE.match(key)
-            if m is None or getattr(arr, "ndim", None) != 1:
-                continue
-            i = int(m.group(2))
-            if i >= len(self.buckets):
-                raise CheckpointReshardError(
-                    f"{key}: bucket index {i} outside the planned layout "
-                    f"({len(self.buckets)} buckets)")
-            elems, old_padded, new_padded = self.buckets[i]
-            if arr.shape[0] != old_padded:
-                raise CheckpointReshardError(
-                    f"{key}: {arr.shape[0]} elements, the plan expected "
-                    f"{old_padded}")
-            if old_padded == new_padded:
-                continue
-            payload = np.asarray(arr)[:elems]
-            if new_padded > elems:
-                payload = np.concatenate(
-                    [payload, np.zeros((new_padded - elems,), arr.dtype)])
-            out[key] = np.ascontiguousarray(payload)
+        if self.buckets is not None:
+            for key, arr in arrays.items():
+                m = _OPT_BUCKET_RE.match(key)
+                if m is None or getattr(arr, "ndim", None) != 1:
+                    continue
+                i = int(m.group(2))
+                if i >= len(self.buckets):
+                    raise CheckpointReshardError(
+                        f"{key}: bucket index {i} outside the planned layout "
+                        f"({len(self.buckets)} buckets)")
+                elems, old_padded, new_padded = self.buckets[i]
+                if arr.shape[0] != old_padded:
+                    raise CheckpointReshardError(
+                        f"{key}: {arr.shape[0]} elements, the plan expected "
+                        f"{old_padded}")
+                if old_padded == new_padded:
+                    continue
+                payload = np.asarray(arr)[:elems]
+                if new_padded > elems:
+                    payload = np.concatenate(
+                        [payload, np.zeros((new_padded - elems,), arr.dtype)])
+                out[key] = np.ascontiguousarray(payload)
+        if self.stacked is not None and self.old_n != self.new_n:
+            self._transform_stacked(out)
         return out
+
+    def _transform_stacked(self, out: dict) -> None:
+        """Worker-wise re-layout of an async rule's stacked trees, in
+        place.  Shrink keeps the FIRST ``new_n`` replicas — every replica
+        is a τ-bounded excursion around the shared center/consensus, so
+        the discarded ones carry no state the survivors (and the center,
+        restored exactly) don't bound.  Grow clones replicas cyclically
+        (``i % old_n``): each new worker is an existing worker's exact
+        (params, state, opt_state) triple, which keeps momentum paired
+        with the params it was accumulated on.  GOSGD's ``(n,)`` consensus
+        weights follow the same index map then renormalize to sum 1 — the
+        conservation invariant the gossip merge is built on."""
+        idx = np.arange(self.new_n) % self.old_n
+        for key, arr in list(out.items()):
+            if key == DATA_STATE_LEAF:
+                continue
+            tree = key.split("::", 1)[0]
+            if tree in ("params", "state", "opt_state"):
+                a = np.asarray(arr)
+                if a.ndim < 1 or a.shape[0] != self.old_n:
+                    raise CheckpointReshardError(
+                        f"{key}: expected a stacked per-worker leading axis "
+                        f"of {self.old_n}, found shape {a.shape} — the "
+                        f"checkpoint does not match its {self.stacked!r} "
+                        f"layout tag")
+                out[key] = np.ascontiguousarray(a[idx])
+            elif tree == "weights":
+                w = np.asarray(arr)
+                if w.shape != (self.old_n,):
+                    raise CheckpointReshardError(
+                        f"{key}: consensus weights have shape {w.shape}, "
+                        f"expected ({self.old_n},)")
+                w = w[idx].astype(np.float64)
+                total = float(w.sum())
+                if not total > 0.0:
+                    raise CheckpointReshardError(
+                        f"{key}: retained consensus mass is {total} — "
+                        f"cannot renormalize")
+                out[key] = np.ascontiguousarray(
+                    (w / total).astype(np.asarray(arr).dtype))
+            # "center" passes through untouched: replicated, n-independent
 
 
 def _plan_zero1_buckets(manifest: dict, old_n: int, new_n: int,
@@ -602,9 +671,11 @@ def plan_reshard(manifest: dict, target_fp: dict,
 
     Raises :class:`CheckpointReshardError` on every unplannable
     transition: missing fingerprint, model-identity mismatch, tp/sp/pp
-    meshes on either side, rule extras (stacked per-worker state), a
-    zero1<->per-leaf optimizer-layout change, or stored bucket shards that
-    disagree with the recomputed layout.
+    meshes on either side, rule extras without a recognized async-rule
+    layout tag (ISSUE 20: ``easgd``/``gosgd``-tagged checkpoints now PLAN
+    a worker-wise re-layout of their stacked trees instead of refusing),
+    a zero1<->per-leaf optimizer-layout change, or stored bucket shards
+    that disagree with the recomputed layout.
     """
     theirs = manifest.get("fingerprint")
     if theirs is None:
@@ -638,17 +709,37 @@ def plan_reshard(manifest: dict, target_fp: dict,
             f"nonsensical data-axis sizes (checkpoint {old_n}, run {new_n})")
     # the __data_state__ payload leaf is device-count-INDEPENDENT by
     # construction (sample cursor, not batch cursor) — never a reshard
-    # obstacle, so it is exempt from the rule-extras refusal below
+    # obstacle, so it is exempt from the rule-extras typing below
     tree_names = {k.split("::", 1)[0] for k in manifest.get("leaves", {})
                   if k != DATA_STATE_LEAF}
     extras = sorted(tree_names - {"params", "state", "opt_state"})
-    if extras:
+    # ISSUE 20: the async rules stamp a layout tag into their fingerprint
+    # ("rule" is NOT in RESHARDABLE_FP_KEYS, so a tag mismatch was already
+    # a fatal model-identity refusal above — here old and new agree).  A
+    # recognized tag turns the old rule-extras refusal into a typed
+    # stacked plan; extras WITHOUT a tag stay a refusal (unknown layout).
+    rule = str(old.get("rule") or "")
+    expected_extras = _ASYNC_RULE_EXTRAS.get(rule)
+    stacked = None
+    if expected_extras is not None:
+        if extras != sorted(expected_extras):
+            raise CheckpointReshardError(
+                f"fingerprint rule {rule!r} promises the extra tree(s) "
+                f"{sorted(expected_extras)} but the checkpoint carries "
+                f"{extras}; reshard refused")
+        stacked = rule
+    elif extras:
         raise CheckpointReshardError(
-            f"checkpoint carries rule extras {extras} (stacked per-worker "
-            f"state, EASGD/GOSGD-style): only the data-parallel BSP layout "
-            f"reshards; reshard refused")
+            f"checkpoint carries rule extras {extras} with no recognized "
+            f"rule tag in its fingerprint (stacked per-worker state of an "
+            f"unknown layout): reshard refused")
     s_old = str(old.get("exchange"))
     s_new = str(new.get("exchange"))
+    if stacked is not None and s_old != s_new:
+        raise CheckpointReshardError(
+            f"async-rule checkpoints reshard only within one trainer class "
+            f"(exchange {s_old!r} -> {s_new!r}): the stacked re-layout is "
+            f"rule-specific; reshard refused")
     if (s_old == "zero1") != (s_new == "zero1"):
         raise CheckpointReshardError(
             f"optimizer-state layout changes between zero1 flat buckets "
@@ -667,14 +758,43 @@ def plan_reshard(manifest: dict, target_fp: dict,
     # already resharded once stamps its cumulative scale): mesh8 -> mesh4
     # -> mesh8 nets exactly 1.0 against the originally tuned LR
     carried = float(manifest.get("lr_scale", 1.0) or 1.0)
-    lr_scale = carried * new_n / old_n
-    if new_n != old_n:
-        warnings.append(
-            f"global batch scales with the device count ({old_n} -> "
-            f"{new_n} workers at fixed per-worker batch); LR rescaled "
-            f"x{lr_scale:g} total (linear-scaling rule"
-            + (f"; carries x{carried:g} from an earlier reshard)"
-               if carried != 1.0 else ")"))
+    if stacked is not None:
+        # async rules: each replica keeps ITS OWN per-worker batch and
+        # update whatever n is — the worker count changes the number of
+        # exploration replicas, not the gradient batch any update sees —
+        # so the linear-scaling rule does NOT apply.  The n-dependent
+        # coupling defaults (EASGD alpha=0.9/n, GOSGD p_push=1/n) adapt
+        # through their "auto" config at trainer construction instead.
+        lr_scale = carried
+        if new_n != old_n:
+            if new_n < old_n:
+                warnings.append(
+                    f"stacked per-worker trees ({stacked}): keeping the "
+                    f"first {new_n} of {old_n} worker replicas (each is a "
+                    f"bounded excursion around the shared center/consensus, "
+                    f"restored exactly)")
+            else:
+                warnings.append(
+                    f"stacked per-worker trees ({stacked}): "
+                    f"{new_n - old_n} new worker replica(s) cloned "
+                    f"cyclically from the existing {old_n}")
+            if stacked == "gosgd":
+                warnings.append(
+                    f"consensus weights re-laid-out and renormalized to "
+                    f"sum 1 over {new_n} workers")
+            warnings.append(
+                "per-worker batch and update are n-independent for async "
+                "rules: LR carried unrescaled (n-dependent coupling "
+                "defaults re-derive at construction)")
+    else:
+        lr_scale = carried * new_n / old_n
+        if new_n != old_n:
+            warnings.append(
+                f"global batch scales with the device count ({old_n} -> "
+                f"{new_n} workers at fixed per-worker batch); LR rescaled "
+                f"x{lr_scale:g} total (linear-scaling rule"
+                + (f"; carries x{carried:g} from an earlier reshard)"
+                   if carried != 1.0 else ")"))
     if old.get("n_subb") != new.get("n_subb"):
         warnings.append(
             f"n_subb changes {old.get('n_subb')} -> {new.get('n_subb')} "
@@ -682,7 +802,7 @@ def plan_reshard(manifest: dict, target_fp: dict,
             f"shift within the documented sub-batching semantics)")
     return ReshardPlan(old_n=old_n, new_n=new_n, strategy_old=s_old,
                        strategy_new=s_new, lr_scale=lr_scale,
-                       buckets=buckets, warnings=warnings)
+                       buckets=buckets, warnings=warnings, stacked=stacked)
 
 
 class SaveHandle:
